@@ -1,0 +1,13 @@
+from .collectives import (  # noqa: F401
+    all_reduce,
+    all_gather,
+    reduce_scatter,
+    broadcast,
+    ppermute_ring,
+    all_to_all,
+    barrier,
+    axis_rank,
+    axis_size,
+    smap,
+)
+from .hlo import count_collectives, lowered_text  # noqa: F401

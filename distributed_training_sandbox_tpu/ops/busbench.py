@@ -1,0 +1,121 @@
+"""Collective bus-bandwidth microbenchmark — the BASELINE.json headline metric.
+
+The reference teaches each collective interactively over 2 NCCL ranks
+(``02-operations.ipynb``) and its real output artifact is NCCL profiler
+traces.  This module produces the ICI side of the side-by-side: per collective,
+per payload size, wall-clock and algorithm/bus bandwidth using the nccl-tests
+accounting so numbers are directly comparable with NCCL's:
+
+    all_reduce      busbw = algbw · 2(n-1)/n
+    all_gather      busbw = algbw · (n-1)/n     (algbw over the *full* tensor)
+    reduce_scatter  busbw = algbw · (n-1)/n
+    ppermute        busbw = algbw               (every link carries the payload)
+    all_to_all      busbw = algbw · (n-1)/n
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, asdict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import collectives as C
+
+
+@dataclass
+class BusResult:
+    collective: str
+    payload_bytes: int
+    n_devices: int
+    time_ms: float
+    algbw_gbps: float
+    busbw_gbps: float
+
+    def to_dict(self):
+        return asdict(self)
+
+
+def _bus_factor(name: str, n: int) -> float:
+    if name == "all_reduce":
+        return 2.0 * (n - 1) / n
+    if name in ("all_gather", "reduce_scatter", "all_to_all"):
+        return (n - 1) / n
+    return 1.0  # ppermute
+
+
+def _build(name: str, mesh: Mesh, axis: str, nelems: int):
+    """Jitted one-collective function + global input shape.
+
+    nccl-tests message-size accounting: for all_reduce / reduce_scatter /
+    ppermute / all_to_all every device holds a full ``nelems`` buffer (global
+    input (n, nelems) sharded on dim 0); for all_gather each device holds
+    ``nelems/n`` and the *output* is the ``nelems`` buffer.  algbw is then
+    ``nelems·itemsize / t`` for every collective, directly comparable with
+    nccl-tests' column of the same name.
+    """
+    n = mesh.devices.size
+    if name == "all_reduce":
+        f = lambda x: C.all_reduce(x[0], axis)
+        in_spec, out_spec, shape = P(axis), P(), (n, nelems)
+    elif name == "all_gather":
+        f = lambda x: C.all_gather(x, axis)
+        in_spec, out_spec, shape = P(axis), P(), (nelems,)
+    elif name == "reduce_scatter":
+        f = lambda x: C.reduce_scatter(x[0], axis)
+        in_spec, out_spec, shape = P(axis), P(axis), (n, nelems)
+    elif name == "ppermute":
+        f = lambda x: C.ppermute_ring(x, axis)
+        in_spec, out_spec, shape = P(axis), P(axis), (n, nelems)
+    elif name == "all_to_all":
+        f = lambda x: C.all_to_all(x[0], axis)[None]
+        in_spec, out_spec, shape = P(axis), P(axis), (n, nelems)
+    else:
+        raise ValueError(name)
+    return jax.jit(C.smap(f, mesh, in_spec, out_spec)), shape
+
+
+def bench_collective(name: str, payload_bytes: int, mesh: Mesh | None = None,
+                     axis: str | None = None, *, dtype=jnp.bfloat16,
+                     iters: int = 10, warmup: int = 3) -> BusResult:
+    """Time one collective at ``payload_bytes`` total payload (the full
+    logical tensor, matching how nccl-tests sizes all_reduce)."""
+    from ..utils.mesh import get_mesh
+    mesh = mesh or get_mesh()
+    axis = axis or mesh.axis_names[0]
+    n = mesh.devices.size
+    itemsize = jnp.dtype(dtype).itemsize
+    nelems = max(payload_bytes // itemsize, n)
+    nelems -= nelems % n  # divisible shards
+    fn, shape = _build(name, mesh, axis, nelems)
+    total = 1
+    for s in shape:
+        total *= s
+    x = jax.device_put(
+        jnp.arange(total, dtype=jnp.float32).astype(dtype).reshape(shape),
+        jax.sharding.NamedSharding(mesh, P(axis)))
+    for _ in range(warmup):
+        jax.block_until_ready(fn(x))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(x)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    algbw = nelems * itemsize / dt / 1e9
+    return BusResult(
+        collective=name,
+        payload_bytes=nelems * itemsize,
+        n_devices=n,
+        time_ms=dt * 1e3,
+        algbw_gbps=algbw,
+        busbw_gbps=algbw * _bus_factor(name, n),
+    )
+
+
+def run_sweep(payloads=(1 << 20, 16 << 20, 128 << 20), mesh: Mesh | None = None,
+              collectives=("all_reduce", "all_gather", "reduce_scatter",
+                           "ppermute", "all_to_all"), **kw) -> list[BusResult]:
+    return [bench_collective(c, p, mesh, **kw)
+            for c in collectives for p in payloads]

@@ -1,0 +1,151 @@
+"""L1 communication backend: explicit XLA collectives over a named mesh.
+
+The reference's L1 is ``torch.distributed`` over NCCL; the complete set of
+collectives it exercises (SURVEY.md §2.3) maps 1:1 onto ``jax.lax`` ops used
+*inside* ``shard_map``:
+
+    dist.all_reduce            -> lax.psum / pmax / pmin (all_reduce here)
+    dist.broadcast             -> masked psum (broadcast here; NCCL's own
+                                  barrier trick in reverse — reference
+                                  README.md:11 notes barriers ARE all_reduces)
+    dist.all_gather(_into_tensor) -> lax.all_gather
+    dist.reduce_scatter_tensor -> lax.psum_scatter
+    dist.send/recv/isend/irecv -> lax.ppermute (ring / point-to-point)
+    dist.all_to_all            -> lax.all_to_all
+    dist.barrier               -> 1-element psum (barrier here)
+    dist.scatter               -> psum_scatter of a masked stack, or slicing
+                                  of a broadcast — provided as ``scatter``
+
+These wrappers exist so strategy code reads like the reference's choreography
+and so traces/HLO show one collective per logical call (shard_map keeps XLA
+from re-choreographing them — SURVEY.md §7.1).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def smap(f, mesh: Mesh, in_specs, out_specs, **kw):
+    """shard_map with this repo's defaults (explicit collectives allowed)."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False, **kw)
+
+
+def axis_rank(axis_name: str) -> jax.Array:
+    """Device's coordinate along ``axis_name`` — the in-SPMD 'rank'."""
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)
+
+
+def all_reduce(x, axis_name: str, op: str = "sum", *, mean: bool = False):
+    """Twin of ``dist.all_reduce`` with SUM/MAX/MIN/PRODUCT (reference
+    ``DDP/ddp.py:46``, ``02-operations.ipynb`` cells 33-36).  ``mean=True``
+    fuses the reference's all_reduce-then-divide-by-ws DDP idiom."""
+    if op == "sum":
+        out = lax.psum(x, axis_name)
+    elif op == "max":
+        out = lax.pmax(x, axis_name)
+    elif op == "min":
+        out = lax.pmin(x, axis_name)
+    elif op in ("prod", "product"):
+        # No pprod primitive: product = sign-corrected exp(sum(log|x|)).
+        # Costs 3 psums (magnitude, sign parity, zero detection) but handles
+        # negatives/zeros like dist.all_reduce(PRODUCT); prod is a teaching
+        # op (02-operations.ipynb cell 36), never on a hot path.
+        neg = lax.psum((x < 0).astype(jnp.float32), axis_name)
+        has_zero = lax.pmax((x == 0).astype(jnp.float32), axis_name)
+        mag = jnp.exp(lax.psum(jnp.log(jnp.abs(jnp.where(x == 0, 1, x))),
+                               axis_name))
+        sign = jnp.where(neg % 2 == 1, -1.0, 1.0)
+        out = jnp.where(has_zero > 0, 0.0, sign * mag).astype(x.dtype)
+    else:
+        raise ValueError(f"unknown reduce op {op!r}")
+    if mean:
+        if op != "sum":
+            raise ValueError("mean only makes sense with sum")
+        out = out / lax.axis_size(axis_name)
+    return out
+
+
+def all_gather(x, axis_name: str, *, axis: int = 0, tiled: bool = True):
+    """Twin of ``dist.all_gather_into_tensor`` (reference ``zero/zero3.py:39``):
+    concatenate every device's shard along ``axis``."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, *, axis: int = 0, tiled: bool = True):
+    """Twin of ``dist.reduce_scatter_tensor`` (reference ``zero/zero2.py:107``):
+    sum across devices, each device keeps its ``axis``-chunk."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=tiled)
+
+
+def broadcast(x, axis_name: str, root=0):
+    """Twin of ``dist.broadcast`` (reference ``DDP/ddp.py:36``,
+    ``zero/zero1.py:102``): every device receives root's value.
+
+    Implemented as a masked psum — one all-reduce on the wire, which is how
+    NCCL traces also account small broadcasts/barriers (reference
+    README.md:11-12).  ``root`` may be traced (zero1 recomputes the owner
+    rank arithmetically per param, ``zero1.py:91-102``)."""
+    mask = (lax.axis_index(axis_name) == root)
+    zeros = jax.tree.map(jnp.zeros_like, x)
+    masked = jax.tree.map(lambda a, z: jnp.where(mask, a, z), x, zeros)
+    return jax.tree.map(lambda a: lax.psum(a, axis_name), masked)
+
+
+def scatter(x, axis_name: str, *, axis: int = 0):
+    """Twin of ``dist.scatter`` (nb cell 30): root's tensor split into
+    equal chunks, one per device.  SPMD formulation: every device slices its
+    own chunk of the (already broadcast) input."""
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    if x.shape[axis] % n:
+        raise ValueError(f"scatter: dim {axis} of size {x.shape[axis]} not "
+                         f"divisible by axis {axis_name!r} size {n}")
+    chunk = x.shape[axis] // n
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=axis)
+
+
+def ppermute_ring(x, axis_name: str, *, shift: int = 1):
+    """Ring send/recv: device i sends to (i+shift) mod n — the twin of the
+    reference's send/recv pairs (``02-operations.ipynb`` cells 11-21) and of
+    pipeline stage hops."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name: str, *, split_axis: int = 0, concat_axis: int = 0,
+               tiled: bool = True):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def barrier(axis_name: str):
+    """Step-isolation barrier: a 1-element psum, exactly what
+    ``dist.barrier`` is under NCCL (reference README.md:11-12,
+    ``zero1.py:19-20``).  Returns the summed token; callers
+    ``block_until_ready`` it for host-side isolation."""
+    return lax.psum(jnp.ones((), dtype=jnp.float32), axis_name)
+
+
+def tree_all_reduce(tree: Any, axis_name: str, *, mean: bool = True):
+    """Per-leaf all_reduce of a pytree — the reference's per-param gradient
+    all_reduce loop (``DDP/ddp.py:43-47``) as one tree_map.  One collective
+    per leaf in the HLO, preserving trace-count parity."""
+    return jax.tree.map(lambda g: all_reduce(g, axis_name, mean=mean), tree)

@@ -1,0 +1,19 @@
+from .mesh import (  # noqa: F401
+    get,
+    make_mesh,
+    get_mesh,
+    register_mesh,
+    setup_distributed,
+    use_cpu_devices,
+)
+from .prng import set_seed, key_for_axis  # noqa: F401
+from .memory import (  # noqa: F401
+    tree_size_mb,
+    device_memory_stats,
+    print_memory_stats,
+    peak_memory_gb,
+)
+from .tracker import PerformanceTracker  # noqa: F401
+from .flops import get_model_flops_per_token  # noqa: F401
+from .profiling import ProfileSchedule, Profiler, annotate, scope  # noqa: F401
+from .config import TrainConfig, build_argparser, build_run_id  # noqa: F401

@@ -1,0 +1,63 @@
+"""One small config layer unifying the reference's three mechanisms (SURVEY.md
+§5.6): inline launcher dicts, per-script argparse, and the TRACE_DIR env
+contract.  External knobs keep the reference surface:
+``--script / --run-name / --num-steps / --num-epochs / --sequence-length /
+--precision`` (reference ``fp8/fp8_benchmark.py:41-50``, ``pp/1f1b.py:172-174``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import re
+from dataclasses import dataclass, field, fields
+
+from .profiling import default_trace_dir
+
+
+def build_run_id(label: str | None = None) -> str:
+    """``YYYYMMDD-HHMMSS[-label]`` run ids, UTC, sanitized label — twin of
+    ``modal_utils.build_run_id`` (``modal_utils.py:98-104``)."""
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%d-%H%M%S")
+    if label:
+        label = re.sub(r"[^A-Za-z0-9_.-]+", "-", label).strip("-")
+        return f"{ts}-{label}" if label else ts
+    return ts
+
+
+@dataclass
+class TrainConfig:
+    num_steps: int = 20
+    num_epochs: int = 1
+    batch_size: int = 32
+    sequence_length: int = 8192
+    precision: str = "bf16"
+    seed: int = 42
+    run_name: str | None = None
+    trace_dir: str = field(default_factory=default_trace_dir)
+    profile: bool = True
+
+    @classmethod
+    def from_args(cls, argv=None, **overrides) -> "TrainConfig":
+        ns, _ = build_argparser().parse_known_args(argv)
+        kwargs = {f.name: getattr(ns, f.name) for f in fields(cls)
+                  if hasattr(ns, f.name) and getattr(ns, f.name) is not None}
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+
+def build_argparser(parser: argparse.ArgumentParser | None = None):
+    p = parser or argparse.ArgumentParser(conflict_handler="resolve")
+    p.add_argument("--num-steps", dest="num_steps", type=int, default=None)
+    p.add_argument("--num-epochs", dest="num_epochs", type=int, default=None)
+    p.add_argument("--batch-size", dest="batch_size", type=int, default=None)
+    p.add_argument("--sequence-length", dest="sequence_length", type=int,
+                   default=None)
+    p.add_argument("--precision", dest="precision",
+                   choices=["bf16", "fp32", "int8", "fp8"], default=None)
+    p.add_argument("--seed", dest="seed", type=int, default=None)
+    p.add_argument("--run-name", dest="run_name", type=str, default=None)
+    p.add_argument("--trace-dir", dest="trace_dir", type=str, default=None)
+    p.add_argument("--no-profile", dest="profile", action="store_false",
+                   default=None)
+    return p

@@ -1,0 +1,53 @@
+"""Analytic transformer FLOPs model, twin of ``get_model_flops_per_token``
+(reference ``fsdp/utils.py:94-115``): per-token forward+backward FLOPs from the
+architecture — attention projections, the sequence-quadratic dot-product term,
+the (gated) MLP, and the vocab head.  Feeds the TFLOPS / MFU metric in
+PerformanceTracker exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class FlopsConfig:
+    hidden_size: int
+    intermediate_size: int
+    num_hidden_layers: int
+    num_attention_heads: int
+    num_key_value_heads: int
+    vocab_size: int
+    tie_word_embeddings: bool = True
+    gated_mlp: bool = True
+
+
+def get_model_flops_per_token(cfg, seq_len: int, *, backward_factor: float = 2.0,
+                              causal: bool = True) -> float:
+    """Forward+backward FLOPs per token.
+
+    Matmul FLOPs count 2·m·n·k; the backward pass re-does each matmul twice
+    (grad-wrt-input and grad-wrt-weight), hence the (1 + backward_factor)
+    multiplier — the same convention the reference's analytic model uses.
+    ``cfg`` is any object with the FlopsConfig attribute names (an HF-style
+    config works unchanged).
+    """
+    h = cfg.hidden_size
+    inter = cfg.intermediate_size
+    layers = cfg.num_hidden_layers
+    n_q = cfg.num_attention_heads
+    n_kv = getattr(cfg, "num_key_value_heads", n_q) or n_q
+    head_dim = getattr(cfg, "head_dim", None) or h // n_q
+    vocab = cfg.vocab_size
+
+    q_proj = 2 * h * (n_q * head_dim)
+    kv_proj = 2 * 2 * h * (n_kv * head_dim)
+    o_proj = 2 * (n_q * head_dim) * h
+    # QK^T and PV: each is 2 · seq · head_dim per head per token; causal
+    # attention touches half the positions on average.
+    attn_quadratic = 2 * 2 * (n_q * head_dim) * seq_len * (0.5 if causal else 1.0)
+    mlp = (3 if getattr(cfg, "gated_mlp", True) else 2) * 2 * h * inter
+    per_layer = q_proj + kv_proj + o_proj + attn_quadratic + mlp
+    head = 2 * h * vocab
+    fwd = layers * per_layer + head
+    return fwd * (1.0 + backward_factor)

@@ -1,0 +1,88 @@
+"""Memory accounting: twin of reference ``training_utils/memory.py`` (component
+sizes in MB by tensor-walking) plus device-allocator stats from the XLA client
+(what ``torch.cuda.memory_allocated / max_memory_allocated`` is to the
+reference, ``device.memory_stats()`` is here — reference
+``DDP/training_utils/memory.py:8-50``, ``fsdp/utils.py:204-219``).
+
+CPU-simulated devices expose no allocator stats; every accessor degrades to
+zeros there so the same scripts run on the CI mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+MB = 1024**2
+GB = 1024**3
+
+
+def tree_size_mb(tree: Any) -> float:
+    """Total size of all array leaves, in MB (tensor-walk twin of
+    ``memory.py:8-34``)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    total = 0
+    for leaf in leaves:
+        if hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+        elif hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+    return total / MB
+
+
+def device_memory_stats(device: jax.Device | None = None) -> dict[str, int]:
+    """Allocator stats for one device: ``bytes_in_use`` / ``peak_bytes_in_use``
+    / ``bytes_limit`` (zeros when the backend exposes none, e.g. CPU sim)."""
+    device = device or jax.local_devices()[0]
+    stats = device.memory_stats() if hasattr(device, "memory_stats") else None
+    stats = stats or {}
+    return {
+        "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+        "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+        "bytes_limit": int(stats.get("bytes_limit", 0)),
+    }
+
+
+def peak_memory_gb(device: jax.Device | None = None) -> float:
+    return device_memory_stats(device)["peak_bytes_in_use"] / GB
+
+
+def all_devices_memory_gb() -> dict[str, dict[str, float]]:
+    """Per-device current/peak GB, twin of ``gpu_memory_usage_all``
+    (``fsdp/utils.py:204-219``)."""
+    out = {}
+    for d in jax.local_devices():
+        s = device_memory_stats(d)
+        out[str(d.id)] = {
+            "current_gb": s["bytes_in_use"] / GB,
+            "peak_gb": s["peak_bytes_in_use"] / GB,
+        }
+    return out
+
+
+def print_memory_stats(
+    tag: str,
+    params: Any = None,
+    grads: Any = None,
+    opt_state: Any = None,
+    *,
+    printer=print,
+) -> dict[str, float]:
+    """Component-wise MB + allocator totals, twin of ``print_memory_stats``
+    (``DDP/training_utils/memory.py:37-50``).  Returns the dict it prints so
+    tests/A-B comparisons can assert on it."""
+    stats = {}
+    if params is not None:
+        stats["model_mb"] = tree_size_mb(params)
+    if grads is not None:
+        stats["grads_mb"] = tree_size_mb(grads)
+    if opt_state is not None:
+        stats["optimizer_mb"] = tree_size_mb(opt_state)
+    dev = device_memory_stats()
+    stats["device_in_use_mb"] = dev["bytes_in_use"] / MB
+    stats["device_peak_mb"] = dev["peak_bytes_in_use"] / MB
+    parts = " | ".join(f"{k}={v:,.1f}" for k, v in stats.items())
+    printer(f"[memory:{tag}] {parts}")
+    return stats

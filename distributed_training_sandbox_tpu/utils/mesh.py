@@ -1,0 +1,150 @@
+"""Named-mesh runtime: the TPU twin of the reference's process-group layer.
+
+The reference keeps a string-keyed accessor over torch.distributed state —
+``get("ws"|"rank"|"lrank"|"pg")`` with an optional registered DeviceMesh
+(reference ``DDP/training_utils/utils.py:49-87``).  Here the process group *is*
+a ``jax.sharding.Mesh``: construction happens once, meshes are registered by
+name, and ``get()`` answers the same questions (world size, process rank,
+local device count, the mesh itself, named-axis sizes).
+
+Unlike NCCL there is no per-rank process by default: JAX is SPMD, so
+device-level "rank" only exists *inside* ``shard_map`` (``lax.axis_index``,
+see ops.collectives.axis_rank).  Host-level rank == ``jax.process_index()``
+and is what multi-host (DCN) code keys on.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Mapping, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_MESHES: dict[str, Mesh] = {}
+DEFAULT_MESH = "default"
+
+
+def use_cpu_devices(n: int = 8) -> None:
+    """Force this process onto ``n`` simulated CPU devices.
+
+    The CI/test substrate (SURVEY.md §7.1): the twin of the reference running
+    gloo on 2 CPU ranks.  Must run before the JAX backend initializes.  When a
+    backend is already live this is a no-op if the platform is already cpu.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+
+
+def setup_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host (DCN) bring-up: twin of ``dist.init_process_group`` at
+    reference ``zero/zero1.py:204``.
+
+    Single-host (the common case here) is a no-op — ICI collectives need no
+    process group.  On a multi-host TPU slice JAX auto-detects the topology,
+    so all arguments are optional.
+    """
+    env_procs = os.environ.get("JAX_NUM_PROCESSES")
+    if num_processes is None and env_procs is not None:
+        num_processes = int(env_procs)
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+
+def make_mesh(
+    axes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    name: str = DEFAULT_MESH,
+    register: bool = True,
+) -> Mesh:
+    """Build a named device mesh.  ``axes`` maps axis name -> size; one size
+    may be -1 (fills with the remaining devices).  Default: 1-D ``dp`` mesh
+    over every device.
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"dp": devs.size}
+    names = tuple(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if devs.size % known:
+            raise ValueError(f"{devs.size} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = devs.size // known
+    total = math.prod(sizes)
+    if total > devs.size:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {devs.size}")
+    mesh = Mesh(devs.flatten()[:total].reshape(sizes), names)
+    if register:
+        _MESHES[name] = mesh
+    return mesh
+
+
+def register_mesh(name: str, mesh: Mesh) -> Mesh:
+    """Twin of the reference's ``cache_mesh`` decorator registry
+    (``DDP/training_utils/utils.py:49-60``)."""
+    _MESHES[name] = mesh
+    return mesh
+
+
+def get_mesh(name: str = DEFAULT_MESH) -> Mesh:
+    if name not in _MESHES:
+        if name == DEFAULT_MESH:
+            return make_mesh()
+        raise KeyError(f"no mesh registered under {name!r}; "
+                       f"have {sorted(_MESHES)}")
+    return _MESHES[name]
+
+
+def get(what: str, mesh_name: str = DEFAULT_MESH):
+    """String-keyed runtime accessor, twin of reference
+    ``DDP/training_utils/utils.py:63-87``.
+
+    Keys:
+      "ws"     -> world size: total device count of the mesh
+      "rank"   -> host/process rank (``jax.process_index()``)
+      "nprocs" -> process count
+      "lrank"  -> local device count on this host
+      "pg" | "mesh" -> the named ``Mesh`` (the process-group analogue)
+      "axis:<name>" -> size of that mesh axis
+    """
+    if what in ("pg", "mesh"):
+        return get_mesh(mesh_name)
+    if what == "ws":
+        return int(get_mesh(mesh_name).devices.size)
+    if what == "rank":
+        return jax.process_index()
+    if what == "nprocs":
+        return jax.process_count()
+    if what == "lrank":
+        return len(jax.local_devices())
+    if what.startswith("axis:"):
+        axis = what.split(":", 1)[1]
+        return int(get_mesh(mesh_name).shape[axis])
+    raise KeyError(f"unknown runtime key {what!r}")
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def sharded(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -1,0 +1,25 @@
+"""Seeded determinism, twin of reference ``set_seed``
+(``DDP/training_utils/utils.py:32-46``): one call seeds every RNG the run
+touches.  On TPU the model/data randomness is a ``jax.random`` key (functional,
+splittable); python/numpy are seeded too for the host-side data pipeline.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import jax
+
+
+def set_seed(seed: int = 42) -> jax.Array:
+    """Seed python/numpy and return the root PRNG key for the run."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def key_for_axis(key: jax.Array, axis_name: str) -> jax.Array:
+    """Per-device key inside ``shard_map``: fold the device's coordinate on
+    ``axis_name`` into ``key``.  The twin of per-rank seeding."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))

@@ -1,0 +1,110 @@
+"""XLA profiler harness with torch.profiler-style schedule semantics.
+
+The reference wraps every hot loop in ``torch.profiler.profile`` with a
+``schedule(skip_first, wait, warmup, active, repeat)`` and
+``tensorboard_trace_handler`` (``DDP/ddp.py:128-151``,
+``fsdp/train_fsdp.py:106-138``), calling ``profiler.step()`` each iteration and
+marking phases with ``record_function``.  The TPU twin drives
+``jax.profiler.start_trace / stop_trace`` from the same schedule state machine
+(warmup steps are traced too — they are how you *see* warmup in the timeline),
+writes TensorBoard/perfetto-compatible traces into the same ``TRACE_DIR``
+contract, and marks phases with ``jax.profiler.TraceAnnotation`` (host span) +
+``jax.named_scope`` (device-side op names).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from dataclasses import dataclass
+
+import jax
+
+
+@dataclass(frozen=True)
+class ProfileSchedule:
+    """skip_first → (wait → warmup+active)×repeat, as in torch.profiler.
+
+    The reference's DDP/zero schedule: skip_first=5, wait=1, warmup=2,
+    active=5, repeat=1 (``DDP/ddp.py:132-138``); fsdp uses wait=5, warmup=5,
+    active=10 (``fsdp/train_fsdp.py:124-137``).
+    """
+    skip_first: int = 5
+    wait: int = 1
+    warmup: int = 2
+    active: int = 5
+    repeat: int = 1
+
+    def phase(self, step: int) -> str:
+        """Phase for 0-based step index: 'skip' | 'wait' | 'trace' | 'done'."""
+        if step < self.skip_first:
+            return "skip"
+        s = step - self.skip_first
+        cycle = self.wait + self.warmup + self.active
+        if self.repeat and s >= cycle * self.repeat:
+            return "done"
+        pos = s % cycle
+        return "wait" if pos < self.wait else "trace"
+
+
+def default_trace_dir() -> str:
+    """TRACE_DIR env contract (reference ``modal_utils.py`` / ``zero1.py:210``:
+    launcher exports TRACE_DIR, scripts default to ./profiler_traces)."""
+    return os.environ.get("TRACE_DIR",
+                          os.environ.get("DDP_TRACE_DIR", "./profiler_traces"))
+
+
+class Profiler:
+    """Schedule-driven jax.profiler session.  Call ``step()`` once per
+    training step (the reference calls ``profiler.step()`` inside the
+    optimizer-step block, ``DDP/ddp.py:172-173``)."""
+
+    def __init__(self, trace_dir: str | None = None,
+                 schedule: ProfileSchedule | None = None,
+                 enabled: bool | None = None):
+        self.trace_dir = trace_dir or default_trace_dir()
+        self.schedule = schedule or ProfileSchedule()
+        # rank-0-only tracing, as in every reference script
+        self.enabled = (jax.process_index() == 0) if enabled is None else enabled
+        self._step = 0
+        self._tracing = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def step(self) -> None:
+        if not self.enabled:
+            return
+        self._step += 1
+        phase = self.schedule.phase(self._step)  # phase of the *next* step
+        if phase == "trace" and not self._tracing:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._tracing = True
+        elif phase in ("wait", "done", "skip") and self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def stop(self) -> None:
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Host-side phase marker, twin of ``record_function`` phase labels
+    ("data_movement", "forward", "sync_grads", "opt_step", … —
+    ``DDP/ddp.py:158-170``).  Shows as a span in the profiler timeline."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def scope(name: str):
+    """Device-side marker for code *inside* jit: prefixes XLA op names so
+    collectives/matmuls attribute to the phase in the trace."""
+    return jax.named_scope(name)

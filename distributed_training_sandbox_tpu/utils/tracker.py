@@ -1,0 +1,71 @@
+"""Warmup-aware throughput/TFLOPS tracker, twin of ``PerformanceTracker``
+(reference ``fsdp/utils.py:129-193``): restarts its clock once warmup steps
+have passed, then reports tokens/s, steps/s, per-device TFLOPS from the
+analytic FLOPs model, and peak device memory.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+from .memory import all_devices_memory_gb, device_memory_stats, GB
+
+
+class PerformanceTracker:
+    def __init__(self, warmup_steps: int = 5, flops_per_token: float | None = None,
+                 num_devices: int | None = None):
+        self.warmup_steps = warmup_steps
+        self.flops_per_token = flops_per_token
+        self.num_devices = num_devices or jax.device_count()
+        self.step_count = 0
+        self.tokens = 0
+        self.total_loss = 0.0
+        self.loss_count = 0
+        self.start = time.perf_counter()
+        self._warmed_up = warmup_steps == 0
+
+    def step(self, tokens: int, loss: float | None = None) -> dict | None:
+        """Record one optimizer step of ``tokens`` tokens.  Returns the metric
+        dict once past warmup, else None.  Restart-at-warmup matches reference
+        ``fsdp/utils.py:155-159``."""
+        self.step_count += 1
+        if not self._warmed_up:
+            if self.step_count >= self.warmup_steps:
+                self._warmed_up = True
+                self.step_count = 0
+                self.tokens = 0
+                self.total_loss = 0.0
+                self.loss_count = 0
+                self.start = time.perf_counter()
+            return None
+        self.tokens += tokens
+        if loss is not None:
+            self.total_loss += float(loss)
+            self.loss_count += 1
+        return self.metrics()
+
+    def metrics(self) -> dict:
+        elapsed = max(time.perf_counter() - self.start, 1e-9)
+        steps_per_second = self.step_count / elapsed
+        tokens_per_second = self.tokens / elapsed
+        out = {
+            "steps_per_second": steps_per_second,
+            "tokens_per_second": tokens_per_second,
+            "total_tokens": self.tokens,
+            "elapsed_s": elapsed,
+        }
+        if self.loss_count:
+            out["avg_loss"] = self.total_loss / self.loss_count
+        if self.flops_per_token:
+            # per-device TFLOPS: tokens/s is the global rate, work is split
+            # across devices (reference fsdp/utils.py:177-179).
+            out["tflops_per_device"] = (
+                tokens_per_second * self.flops_per_token / self.num_devices / 1e12
+            )
+        peak = device_memory_stats()["peak_bytes_in_use"]
+        if peak:
+            out["peak_memory_gb"] = peak / GB
+            out["memory_all_devices"] = all_devices_memory_gb()
+        return out

@@ -1,0 +1,23 @@
+"""Test substrate: 8 simulated CPU devices (SURVEY.md §7.1) — the twin of the
+reference's gloo-on-2-CPU-ranks mode.  Must configure XLA before the backend
+initializes, hence the env mutation at import time."""
+
+from distributed_training_sandbox_tpu.utils import use_cpu_devices
+
+use_cpu_devices(8)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    assert len(jax.devices()) == 8, "expected 8 simulated CPU devices"
+    return Mesh(np.array(jax.devices()).reshape(8), ("dp",))
+
+
+@pytest.fixture(scope="session")
+def mesh2x4():
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
