@@ -1,0 +1,66 @@
+"""Toy MLPs matching the reference's strategy-exercise models.
+
+Two configurations recur in the reference (SURVEY.md §2.4):
+  * the ZeRO toy: 6 × Linear(10_000, 10_000) with ReLU between
+    (reference ``zero/zero1.py:237-249``) — 12 params, ~1.2 GB fp32, big
+    enough that sharding optimizer state visibly moves peak memory;
+  * the PP toy: Linear(50,500) → 4×Linear(500,500) → Linear(500,50) with
+    ReLU between (reference ``pp/gpipe.py:23-35``).
+
+Params are a plain pytree: a list of ``{"w": (in, out), "b": (out,)}`` dicts,
+one per linear layer — 2 leaves per layer, so per-param collective counts map
+1:1 to the reference's 12-param traces.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+ZERO_TOY_SIZES = (10_000,) * 7
+PP_TOY_SIZES = (50, 500, 500, 500, 500, 500, 50)
+
+
+def init_mlp(key: jax.Array, sizes, dtype=jnp.float32) -> list[dict]:
+    """Kaiming-uniform init (torch nn.Linear's default), so A/B peak-memory
+    and loss curves are comparable with the reference's toys."""
+    params = []
+    for i in range(len(sizes) - 1):
+        key, wk, bk = jax.random.split(key, 3)
+        fan_in = sizes[i]
+        bound = 1.0 / math.sqrt(fan_in)
+        w = jax.random.uniform(wk, (sizes[i], sizes[i + 1]),
+                               minval=-math.sqrt(6.0 / fan_in) / math.sqrt(2),
+                               maxval=math.sqrt(6.0 / fan_in) / math.sqrt(2),
+                               dtype=jnp.float32)
+        b = jax.random.uniform(bk, (sizes[i + 1],), minval=-bound,
+                               maxval=bound, dtype=jnp.float32)
+        params.append({"w": w.astype(dtype), "b": b.astype(dtype)})
+    return params
+
+
+def mlp_apply(params: list[dict], x: jax.Array) -> jax.Array:
+    """ReLU between layers, none after the last (nn.Sequential twin)."""
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def zero_toy_mlp(key: jax.Array, dtype=jnp.float32, scale: int = 1):
+    """The ZeRO exercise model; ``scale`` divides the width for fast tests."""
+    sizes = tuple(s // scale for s in ZERO_TOY_SIZES)
+    return init_mlp(key, sizes, dtype)
+
+
+def pp_toy_mlp(key: jax.Array, dtype=jnp.float32):
+    return init_mlp(key, PP_TOY_SIZES, dtype)
+
+
+def mse_loss(params, batch, apply_fn=mlp_apply):
+    x, y = batch
+    pred = apply_fn(params, x)
+    return jnp.mean((pred - y) ** 2)

@@ -3,11 +3,13 @@ from .collectives import (  # noqa: F401
     all_gather,
     reduce_scatter,
     broadcast,
+    scatter,
     ppermute_ring,
     all_to_all,
     barrier,
     axis_rank,
     axis_size,
     smap,
+    tree_all_reduce,
 )
 from .hlo import count_collectives, lowered_text  # noqa: F401

@@ -31,16 +31,18 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.6 top-level export
+try:  # jax >= 0.6 top-level export (check_vma kwarg)
     from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
+    _RELAX_KW = {"check_vma": False}
+except ImportError:  # pragma: no cover - older jax: experimental, check_rep
     from jax.experimental.shard_map import shard_map as _shard_map
+    _RELAX_KW = {"check_rep": False}
 
 
 def smap(f, mesh: Mesh, in_specs, out_specs, **kw):
     """shard_map with this repo's defaults (explicit collectives allowed)."""
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                      check_vma=False, **kw)
+                      **_RELAX_KW, **kw)
 
 
 def axis_rank(axis_name: str) -> jax.Array:

@@ -1,0 +1,8 @@
+from . import optim  # noqa: F401
+from .ddp import (  # noqa: F401
+    sync_gradients,
+    broadcast_params,
+    params_sync_error,
+    make_ddp_train_step,
+    shard_range,
+)

@@ -1,0 +1,101 @@
+"""Data parallelism from scratch — TPU twin of
+``SimpleDistributedDataParallelism`` (reference ``DDP/ddp.py:30-56``).
+
+Choreography parity with the reference:
+  * init: every param broadcast from rank 0, then a cross-replica equality
+    assertion (``DDP/ddp.py:34-41``) — here ``broadcast_params`` +
+    ``params_sync_error`` (a psum'd divergence norm, the SPMD form of the
+    same invariant, SURVEY.md §5.2);
+  * per step: local forward/backward, then ``sync_gradients`` = one
+    all_reduce **per param** followed by /world_size (``DDP/ddp.py:43-47``)
+    — ``tree_all_reduce(mean=True)``, one psum per leaf in the HLO so trace
+    counts match the reference's per-param NCCL kernels;
+  * data: each rank takes a contiguous range of the dataset
+    (``DDP/ddp.py:104-112``) — ``shard_range`` host-side, or hand the global
+    batch to shard_map with in_spec P(axis) and let SPMD slice it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import collectives as C
+from ..utils.profiling import scope
+
+
+def broadcast_params(params, axis: str, root: int = 0):
+    """Per-param broadcast from ``root`` (one collective per leaf)."""
+    return jax.tree.map(lambda p: C.broadcast(p, axis, root), params)
+
+
+def params_sync_error(params, axis: str) -> jax.Array:
+    """Total squared divergence of params across the axis — 0.0 iff all
+    replicas hold identical values (the DDP init assertion, SPMD form)."""
+    def leaf_err(p):
+        # compare against rank 0's value (a masked psum adds exact zeros, so
+        # identical replicas give exactly 0.0 — a mean would not, since the
+        # reduction's rounding differs from the local value)
+        return jnp.sum((p - C.broadcast(p, axis, 0)) ** 2)
+    errs = jax.tree.map(leaf_err, params)
+    return C.all_reduce(
+        jax.tree.reduce(jnp.add, errs, jnp.zeros(())), axis)
+
+
+def sync_gradients(grads, axis: str):
+    """Per-param all_reduce(SUM) then /ws (``DDP/ddp.py:43-47``)."""
+    return C.tree_all_reduce(grads, axis, mean=True)
+
+
+def shard_range(n: int, ws: int, rank: int) -> range:
+    """Contiguous per-rank dataset shard, remainder to the leading ranks —
+    twin of ``DDP/ddp.py:104-112``."""
+    base, rem = divmod(n, ws)
+    start = rank * base + min(rank, rem)
+    return range(start, start + base + (1 if rank < rem else 0))
+
+
+def make_ddp_train_step(
+    loss_fn: Callable,
+    update_fn: Callable,
+    mesh: Mesh,
+    axis: str = "dp",
+    *,
+    with_barrier: bool = True,
+    donate: bool = True,
+):
+    """Build the jitted DDP step: (params, opt_state, batch) ->
+    (params, opt_state, loss).
+
+    ``loss_fn(params, local_batch) -> scalar``; ``update_fn(grads, opt_state,
+    params) -> (params, opt_state)`` (see parallel.optim).  The batch enters
+    sharded on ``axis`` (global batch dim); params/opt state are replicated.
+    ``with_barrier`` appends the 1-elem-psum step barrier the reference uses
+    for trace isolation (``zero/zero1.py:184``, README.md:11-12).
+    """
+
+    def step(params, opt_state, batch):
+        with scope("forward_backward"):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        with scope("sync_grads"):
+            grads = sync_gradients(grads, axis)
+            # the loss is reported averaged over the global batch, like the
+            # reference's rank-0 print of its local loss post-allreduce-free
+            loss = C.all_reduce(loss, axis, mean=True)
+        with scope("opt_step"):
+            params, opt_state = update_fn(grads, opt_state, params)
+        if with_barrier:
+            with scope("barrier"):
+                loss = loss + 0.0 * C.barrier(axis)
+        return params, opt_state, loss
+
+    sharded_step = C.smap(
+        step, mesh,
+        in_specs=(P(), P(), P(axis)),
+        out_specs=(P(), P(), P()),
+    )
+    return jax.jit(sharded_step, donate_argnums=(0, 1) if donate else ())

@@ -18,7 +18,6 @@ class FlopsConfig:
     num_attention_heads: int
     num_key_value_heads: int
     vocab_size: int
-    tie_word_embeddings: bool = True
     gated_mlp: bool = True
 
 

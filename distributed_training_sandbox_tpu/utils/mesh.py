@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import math
 import os
+import re
 from typing import Mapping, Sequence
 
 import numpy as np
@@ -35,10 +36,14 @@ def use_cpu_devices(n: int = 8) -> None:
     backend is already live this is a no-op if the platform is already cpu.
     """
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}"
         ).strip()
+    elif int(m.group(1)) != n:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}")
     jax.config.update("jax_platforms", "cpu")
 
 
