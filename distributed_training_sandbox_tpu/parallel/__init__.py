@@ -1,4 +1,5 @@
 from . import optim  # noqa: F401
+from . import zero  # noqa: F401
 from .ddp import (  # noqa: F401
     sync_gradients,
     broadcast_params,

@@ -9,6 +9,7 @@ from .mesh import (  # noqa: F401
 from .prng import set_seed, key_for_axis  # noqa: F401
 from .memory import (  # noqa: F401
     tree_size_mb,
+    tree_local_size_mb,
     device_memory_stats,
     print_memory_stats,
     peak_memory_gb,

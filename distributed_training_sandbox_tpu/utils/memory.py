@@ -32,6 +32,24 @@ def tree_size_mb(tree: Any) -> float:
     return total / MB
 
 
+def tree_local_size_mb(tree: Any) -> float:
+    """Size of the *locally addressable* shards of all leaves, in MB — what
+    one device actually holds.  For a ZeRO-sharded optimizer state this is
+    ~1/ws of ``tree_size_mb``; that delta is the reference's A/B "pass
+    signal" (``zero/zero1.py:316-324``)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            local_dev_ids = {s.device.id for s in shards}
+            # per-device bytes: one device's worth of addressable data
+            per_dev = sum(s.data.nbytes for s in shards) / max(len(local_dev_ids), 1)
+            total += per_dev
+        elif hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+    return total / MB
+
+
 def device_memory_stats(device: jax.Device | None = None) -> dict[str, int]:
     """Allocator stats for one device: ``bytes_in_use`` / ``peak_bytes_in_use``
     / ``bytes_limit`` (zeros when the backend exposes none, e.g. CPU sim)."""

@@ -1,0 +1,147 @@
+"""Shared A/B driver for the ZeRO strategy scripts — the factored-out twin of
+the ~200 lines of train/profile boilerplate each reference zero file repeats
+(SURVEY.md §2.8).  Flow mirrors ``test_zeroN()`` (``zero/zero1.py:203,331``):
+one process runs a baseline-Adam leg, then the sharded leg on an
+identically-seeded model, and prints the per-device optimizer-memory delta as
+the pass signal, plus step timing, an estimated comm/compute split, and the
+per-step HLO collective counts (the trace-parity upgrade).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _time_steps(step_fn, state, batch, n_steps, profiler=None, label=""):
+    """Run n_steps (first is untimed warmup/compile, like the reference's
+    explicit warmup step, zero1.py:118-125). Returns (state, losses, sec/step)."""
+    import jax
+    params, opt = state
+    losses = []
+    t0 = None
+    for i in range(max(n_steps, 2)):
+        params, opt, loss = step_fn(params, opt, batch)
+        jax.block_until_ready(loss)
+        if i == 0:
+            t0 = time.perf_counter()  # discard compile step
+        else:
+            losses.append(float(loss))
+        if profiler:
+            profiler.step()
+    dt = (time.perf_counter() - t0) / max(n_steps - 1, 1)
+    print(f"[{label}] {len(losses)} timed steps, {dt * 1e3:.2f} ms/step, "
+          f"final loss {losses[-1]:.6f}")
+    return (params, opt), losses, dt
+
+
+def run_zero_ab(stage: int, argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--scale", type=int, default=20,
+                   help="divide the 10k toy width by this")
+    p.add_argument("--rebuild", choices=["broadcast", "all_gather"],
+                   default="broadcast")
+    args, rest = p.parse_known_args(argv)
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    import jax
+    import numpy as np
+    from distributed_training_sandbox_tpu.utils import (
+        TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
+        tree_size_mb, tree_local_size_mb, print_memory_stats)
+    from distributed_training_sandbox_tpu.models import zero_toy_mlp
+    from distributed_training_sandbox_tpu.models.mlp import mse_loss
+    from distributed_training_sandbox_tpu.parallel import make_ddp_train_step, optim
+    from distributed_training_sandbox_tpu.parallel.zero import (
+        make_zero_train_step, init_zero_opt_state, make_zero3_train_step,
+        make_zero3_mlp_loss, shard_params_zero3)
+    from distributed_training_sandbox_tpu.ops import count_collectives
+
+    cfg = TrainConfig.from_args(rest, batch_size=16)
+    mesh = make_mesh()
+    ws = get("ws")
+    name = f"zero{stage}"
+    print(f"[{name}] mesh={dict(mesh.shape)} ws={ws} "
+          f"platform={jax.devices()[0].platform} scale={args.scale}")
+
+    key = set_seed(cfg.seed)
+    params = zero_toy_mlp(key, scale=args.scale)
+    kx, ky = jax.random.split(key)
+    width = 10_000 // args.scale
+    batch = (jax.random.normal(kx, (cfg.batch_size, width)),
+             jax.random.normal(ky, (cfg.batch_size, width)))
+
+    # fresh Profiler per leg: a repeat=1 schedule is consumed by the first
+    # leg's steps, so sharing one would leave the sharded leg untraced
+    def make_prof(leg):
+        if not cfg.profile:
+            return None
+        return Profiler(trace_dir=f"{cfg.trace_dir}/{name}/{leg}",
+                        schedule=ProfileSchedule())
+
+    # ---- leg A: baseline Adam (replicated state, DDP-style) --------------
+    base_opt = optim.adam_init(params)
+    base_step = make_ddp_train_step(
+        mse_loss, lambda g, s, p: optim.adam_update(g, s, p), mesh, "dp",
+        donate=False)
+    base_counts = count_collectives(base_step, params, base_opt, batch)
+    prof = make_prof("baseline")
+    (_, base_opt_f), base_losses, base_dt = _time_steps(
+        base_step, (params, base_opt), batch, cfg.num_steps, prof, "baseline")
+    if prof:
+        prof.stop()
+    base_opt_mb = tree_local_size_mb(base_opt_f.mu) + \
+        tree_local_size_mb(base_opt_f.nu)
+
+    # ---- leg B: sharded optimizer ----------------------------------------
+    opt = init_zero_opt_state(params, mesh, "dp")
+    if stage in (1, 2):
+        step = make_zero_train_step(mse_loss, mesh, "dp", stage=stage,
+                                    rebuild=args.rebuild, donate=False)
+        state0 = (params, opt)
+    else:
+        shapes = [{k: v.shape for k, v in layer.items()} for layer in params]
+        loss_fn = make_zero3_mlp_loss(shapes, "dp")
+        step = make_zero3_train_step(loss_fn, mesh, "dp", donate=False)
+        state0 = (shard_params_zero3(params, mesh, "dp"), opt)
+    shard_counts = count_collectives(step, *state0, batch)
+    prof = make_prof("sharded")
+    (shard_params_f, opt_f), shard_losses, shard_dt = _time_steps(
+        step, state0, batch, cfg.num_steps, prof, name)
+    if prof:
+        prof.stop()
+    shard_opt_mb = tree_local_size_mb(opt_f.mu) + tree_local_size_mb(opt_f.nu)
+
+    # ---- comparison report (the reference's pass signal) -----------------
+    n_params = len(jax.tree.leaves(params))
+    print(f"\n[{name}] === A/B report ===")
+    print(f"[{name}] params: {n_params} tensors, "
+          f"{tree_size_mb(params):.1f} MB global")
+    print(f"[{name}] per-device optimizer state: baseline {base_opt_mb:.2f} MB"
+          f" -> sharded {shard_opt_mb:.2f} MB "
+          f"({base_opt_mb / max(shard_opt_mb, 1e-9):.1f}x smaller, ws={ws})")
+    if stage == 3:
+        print(f"[{name}] per-device params: full {tree_size_mb(params):.2f} MB"
+              f" -> chunks {tree_local_size_mb(shard_params_f):.2f} MB")
+    print(f"[{name}] step time: baseline {base_dt * 1e3:.2f} ms, "
+          f"sharded {shard_dt * 1e3:.2f} ms")
+    print(f"[{name}] per-step collectives baseline: {base_counts}")
+    print(f"[{name}] per-step collectives sharded:  {shard_counts}")
+    drift = float(np.max(np.abs(np.array(base_losses) - np.array(shard_losses))))
+    print(f"[{name}] loss drift baseline-vs-sharded: {drift:.2e} "
+          f"({'OK' if drift < 1e-3 else 'DIVERGED'})")
+    print_memory_stats(f"{name}-final")
+    return {
+        "stage": stage, "ws": ws,
+        "base_opt_mb": base_opt_mb, "shard_opt_mb": shard_opt_mb,
+        "base_ms": base_dt * 1e3, "shard_ms": shard_dt * 1e3,
+        "base_counts": base_counts, "shard_counts": shard_counts,
+        "loss_drift": float(drift),
+    }

@@ -1,0 +1,14 @@
+"""ZeRO-1 (optimizer-state sharding) A/B — runnable twin of reference
+``zero/zero1.py``: baseline Adam vs ShardedOptimizer choreography
+(per-param grad all_reduce -> chunk Adam -> per-param rebuild broadcast).
+
+Usage: python scripts/zero1.py [--cpu-devices 8] [--scale 20] [--num-steps 20]
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _zero_driver import run_zero_ab
+
+if __name__ == "__main__":
+    run_zero_ab(stage=1)

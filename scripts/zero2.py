@@ -1,0 +1,14 @@
+"""ZeRO-2 (+gradient sharding) A/B — runnable twin of reference
+``zero/zero2.py``: per-param grad reduce_scatter straight into the owned
+chunk (no ws-fold concat spike), chunk Adam, per-param rebuild.
+
+Usage: python scripts/zero2.py [--cpu-devices 8] [--scale 20] [--num-steps 20]
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _zero_driver import run_zero_ab
+
+if __name__ == "__main__":
+    run_zero_ab(stage=2)

@@ -1,0 +1,15 @@
+"""ZeRO-3 (param+grad+optimizer sharding) A/B — runnable twin of reference
+``zero/zero3.py``: params chunk-sharded at rest, per-layer all_gather
+materialize in forward and (via jax.checkpoint) backward, grads arriving as
+psum_scatters, chunk Adam, no broadcast.
+
+Usage: python scripts/zero3.py [--cpu-devices 8] [--scale 20] [--num-steps 20]
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _zero_driver import run_zero_ab
+
+if __name__ == "__main__":
+    run_zero_ab(stage=3)

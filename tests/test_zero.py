@@ -1,0 +1,160 @@
+"""ZeRO-1/2/3: loss/param parity with unsharded Adam, collective-count
+parity with the reference's traces, memory-sharding accounting, and the
+reference's whole-param partition rule."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_sandbox_tpu.models import init_mlp
+from distributed_training_sandbox_tpu.models.mlp import mse_loss
+from distributed_training_sandbox_tpu.parallel import optim
+from distributed_training_sandbox_tpu.parallel.zero import (
+    partition_params, owner_of_param, make_zero_train_step,
+    init_zero_opt_state, make_zero3_train_step, make_zero3_mlp_loss,
+    shard_params_zero3, chunk_shapes)
+from distributed_training_sandbox_tpu.ops import count_collectives
+from distributed_training_sandbox_tpu.utils import set_seed, tree_size_mb, \
+    tree_local_size_mb
+
+# width 48: divisible by 8 so chunks are pad-free; plus a pad-needing case
+SIZES = (48, 48, 48, 48)         # 3 layers -> 6 params
+SIZES_RAGGED = (30, 44, 18)      # pad-exercising
+
+
+def make_setup(sizes=SIZES, batch=16):
+    key = set_seed(0)
+    params = init_mlp(key, sizes)
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (batch, sizes[0]))
+    y = jax.random.normal(ky, (batch, sizes[-1]))
+    return params, (x, y)
+
+
+def reference_adam_run(params, batch, n_steps, lr=1e-3):
+    state = optim.adam_init(params)
+    losses = []
+    for _ in range(n_steps):
+        loss, grads = jax.value_and_grad(mse_loss)(params, batch)
+        params, state = optim.adam_update(grads, state, params, lr=lr)
+        losses.append(float(loss))
+    return params, losses
+
+
+def test_partition_rule_matches_reference():
+    # 12 params over 5 ranks: 3,3,2,2,2 contiguous (remainder spread)
+    part = partition_params(12, 5)
+    assert [len(p) for p in part] == [3, 3, 2, 2, 2]
+    assert part[0] == [0, 1, 2] and part[4] == [10, 11]
+    for i in range(12):
+        owners = [r for r, idxs in enumerate(part) if i in idxs]
+        assert owners == [owner_of_param(i, 12, 5)]
+
+
+@pytest.mark.parametrize("stage", [1, 2])
+@pytest.mark.parametrize("sizes", [SIZES, SIZES_RAGGED])
+def test_zero12_parity_with_adam(mesh8, stage, sizes):
+    """Sharded-optimizer training == plain Adam on the same global batch."""
+    params, batch = make_setup(sizes)
+    opt = init_zero_opt_state(params, mesh8, "dp")
+    step = make_zero_train_step(mse_loss, mesh8, "dp", stage=stage,
+                                donate=False)
+    losses = []
+    p = params
+    for _ in range(4):
+        p, opt, loss = step(p, opt, batch)
+        losses.append(float(loss))
+    ref_params, ref_losses = reference_adam_run(params, batch, 4)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.parametrize("sizes", [SIZES, SIZES_RAGGED])
+def test_zero3_parity_with_adam(mesh8, sizes):
+    params, batch = make_setup(sizes)
+    shapes = [{k: v.shape for k, v in layer.items()} for layer in params]
+    chunks = shard_params_zero3(params, mesh8, "dp")
+    opt = init_zero_opt_state(params, mesh8, "dp")
+    loss_fn = make_zero3_mlp_loss(shapes, "dp")
+    step = make_zero3_train_step(loss_fn, mesh8, "dp", donate=False)
+    losses = []
+    c = chunks
+    for _ in range(4):
+        c, opt, loss = step(c, opt, batch)
+        losses.append(float(loss))
+    ref_params, ref_losses = reference_adam_run(params, batch, 4)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+    # compare updated chunks against the chunked reference params
+    ref_chunks = shard_params_zero3(ref_params, mesh8, "dp")
+    for a, b in zip(jax.tree.leaves(c), jax.tree.leaves(ref_chunks)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_zero1_collective_counts(mesh8):
+    """Reference README.md:18: 12 grad all_reduces + 12 param broadcasts per
+    step (60+60 over 5 profiled steps) + loss mean + barrier."""
+    params, batch = make_setup()
+    opt = init_zero_opt_state(params, mesh8, "dp")
+    step = make_zero_train_step(mse_loss, mesh8, "dp", stage=1, donate=False)
+    c = count_collectives(step, params, opt, batch)
+    n = len(jax.tree.leaves(params))  # 6
+    assert c["all_reduce"] == 2 * n + 2  # grads + rebuild-psums + loss + barrier
+    assert c["reduce_scatter"] == 0 and c["all_gather"] == 0
+
+
+def test_zero2_collective_counts(mesh8):
+    params, batch = make_setup()
+    opt = init_zero_opt_state(params, mesh8, "dp")
+    step = make_zero_train_step(mse_loss, mesh8, "dp", stage=2, donate=False)
+    c = count_collectives(step, params, opt, batch)
+    n = len(jax.tree.leaves(params))
+    assert c["reduce_scatter"] == n          # per-param grad reduce_scatter
+    assert c["all_reduce"] == n + 2          # rebuilds + loss + barrier
+    step_ag = make_zero_train_step(mse_loss, mesh8, "dp", stage=2,
+                                   rebuild="all_gather", donate=False)
+    c2 = count_collectives(step_ag, params, opt, batch)
+    assert c2["all_gather"] == n and c2["all_reduce"] == 2
+
+
+def test_zero3_collective_counts(mesh8):
+    """Reference README.md:20 choreography: all_gather per param in forward
+    AND backward (120/5 steps = 12+12 for 12 params); grads arrive as
+    psum_scatters (the all_reduce-then-discard upgrade)."""
+    params, batch = make_setup()  # 3 layers, 6 params
+    shapes = [{k: v.shape for k, v in layer.items()} for layer in params]
+    chunks = shard_params_zero3(params, mesh8, "dp")
+    opt = init_zero_opt_state(params, mesh8, "dp")
+    step = make_zero3_train_step(make_zero3_mlp_loss(shapes, "dp"),
+                                 mesh8, "dp", donate=False)
+    c = count_collectives(step, chunks, opt, batch)
+    n = len(jax.tree.leaves(params))
+    # fwd + bwd re-gather per param; the LAST layer's re-gather is adjacent
+    # to its forward twin and gets CSE'd away in lowering (2n-1) — the
+    # reference's hook version has the same redundancy but NCCL can't dedup
+    assert c["all_gather"] in (2 * n - 1, 2 * n)
+    assert c["reduce_scatter"] == n   # grad transpose
+    assert c["all_reduce"] == 2      # loss mean + barrier
+
+
+def test_zero_memory_sharding(mesh8):
+    """Per-device optimizer state is ~1/8 of the global state; zero3 also
+    shards params 8x."""
+    params, _ = make_setup()
+    opt = init_zero_opt_state(params, mesh8, "dp")
+    global_mb = tree_size_mb(opt.mu) + tree_size_mb(opt.nu)
+    local_mb = tree_local_size_mb(opt.mu) + tree_local_size_mb(opt.nu)
+    assert abs(local_mb - global_mb / 8) / global_mb < 0.01
+    chunks = shard_params_zero3(params, mesh8, "dp")
+    assert tree_local_size_mb(chunks) < tree_size_mb(params) / 7.5
+    # baseline adam state for comparison: fully replicated
+    base = optim.adam_init(params)
+    assert abs(tree_local_size_mb(base.mu) - tree_size_mb(base.mu)) < 1e-9
+
+
+def test_chunk_shapes_padding():
+    params = [{"w": jnp.zeros((30, 44)), "b": jnp.zeros((44,))}]
+    cs = chunk_shapes(params, 8)
+    assert cs[0]["w"].shape == (165,)  # 1320/8
+    assert cs[0]["b"].shape == (6,)    # pad 44 -> 48
