@@ -1,0 +1,137 @@
+"""Packed causal-LM pretraining pipeline.
+
+Twin of the reference's TinyStories pipeline (``fsdp/utils.py:29-91``):
+tokenize every document → concatenate all tokens into one stream → slice
+into fixed (seq_len + 1) windows → ``input_ids = window[:-1]``,
+``labels = window[1:]``.  That packing logic is pure Python and ports
+conceptually as-is; what changes is the substrate:
+
+  * the host-side pipeline feeds jax arrays (device put happens at the
+    train loop, sharded over the ``dp`` axis);
+  * the download path (HF ``datasets`` + ``transformers`` tokenizer) is
+    *gated*: on an air-gapped TPU pod it degrades to a seeded synthetic
+    token stream with a Zipfian unigram distribution — the same role the
+    reference's ``randn`` batches play for the toys (``zero1.py:115-117``).
+
+The reference's split knob (5% fsdp vs 10% fp8 — the single line differing
+between its two copies of utils.py, SURVEY.md §2.8) survives as the
+``split_percent`` argument of one shared function.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pack_tokens(tokens: np.ndarray, seq_len: int) -> tuple[np.ndarray, np.ndarray]:
+    """Concatenated token stream → (input_ids, labels), each
+    (n_windows, seq_len).  Window stride is seq_len + 1 and the ragged tail
+    is dropped, exactly as reference ``fsdp/utils.py:58-89``."""
+    tokens = np.asarray(tokens).reshape(-1)
+    window = seq_len + 1
+    n = len(tokens) // window
+    if n == 0:
+        raise ValueError(f"stream of {len(tokens)} tokens too short for one "
+                         f"window of {window}")
+    w = tokens[: n * window].reshape(n, window)
+    return w[:, :-1].astype(np.int32), w[:, 1:].astype(np.int32)
+
+
+def synthetic_token_stream(num_tokens: int, vocab_size: int,
+                           seed: int = 42) -> np.ndarray:
+    """Seeded Zipfian token stream — deterministic, offline, with a
+    realistic (skewed) unigram distribution so loss curves behave like text
+    rather than uniform noise."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
+    probs = 1.0 / ranks
+    probs /= probs.sum()
+    return rng.choice(vocab_size, size=num_tokens, p=probs).astype(np.int32)
+
+
+def get_tinystories_tokens(tokenizer_name: str = "HuggingFaceTB/SmolLM3-3B",
+                           split_percent: int = 5,
+                           max_docs: int | None = None) -> np.ndarray:
+    """Tokenize TinyStories into one concatenated stream (reference
+    ``fsdp/utils.py:29-57``; ``split_percent`` 5 = fsdp flavor, 10 = fp8
+    flavor).  Requires network + ``datasets``/``transformers``; callers on
+    air-gapped hosts should catch and fall back to
+    ``synthetic_token_stream``."""
+    from datasets import load_dataset  # gated import
+    from transformers import AutoTokenizer
+
+    ds = load_dataset("roneneldan/TinyStories",
+                      split=f"train[:{split_percent}%]")
+    tok = AutoTokenizer.from_pretrained(tokenizer_name)
+    chunks = []
+    for i, doc in enumerate(ds):
+        if max_docs is not None and i >= max_docs:
+            break
+        ids = tok(doc["text"])["input_ids"]
+        ids.append(tok.eos_token_id)
+        chunks.append(np.asarray(ids, dtype=np.int32))
+    return np.concatenate(chunks)
+
+
+def _hub_reachable(timeout: float = 2.0) -> bool:
+    """Fast offline detection so ``source="auto"`` doesn't sit through HF's
+    retry/backoff loop on air-gapped hosts."""
+    import os
+    import socket
+    if os.environ.get("HF_HUB_OFFLINE") or os.environ.get("HF_DATASETS_OFFLINE"):
+        return False
+    prev = socket.getdefaulttimeout()
+    try:
+        socket.setdefaulttimeout(timeout)
+        socket.getaddrinfo("huggingface.co", 443)
+        return True
+    except OSError:
+        return False
+    finally:
+        socket.setdefaulttimeout(prev)
+
+
+def make_packed_dataset(seq_len: int, vocab_size: int, *,
+                        num_tokens: int | None = None,
+                        split_percent: int = 5,
+                        seed: int = 42,
+                        source: str = "auto"):
+    """One-call dataset: (input_ids, labels) arrays.
+
+    source: "tinystories" (requires network), "synthetic", or "auto"
+    (tinystories with synthetic fallback — the zero-egress default).
+    """
+    if source not in ("tinystories", "synthetic", "auto"):
+        raise ValueError(f"unknown source {source!r}; expected 'tinystories',"
+                         f" 'synthetic' or 'auto'")
+    if source in ("tinystories", "auto"):
+        try:
+            if source == "auto" and not _hub_reachable():
+                raise OSError("hub unreachable")
+            stream = get_tinystories_tokens(split_percent=split_percent)
+            if stream.max() >= vocab_size:
+                # JAX clamps OOB gather indices silently — never feed a
+                # tokenizer's ids to a smaller model vocab.
+                raise ValueError(
+                    f"TinyStories token ids go up to {stream.max()}, model "
+                    f"vocab is {vocab_size}; use a matching tokenizer or "
+                    f"source='synthetic'")
+            return pack_tokens(stream, seq_len)
+        except Exception:
+            if source == "tinystories":
+                raise
+    if num_tokens is None:
+        num_tokens = 64 * (seq_len + 1)
+    stream = synthetic_token_stream(num_tokens, vocab_size, seed)
+    return pack_tokens(stream, seq_len)
+
+
+def packed_batches(input_ids: np.ndarray, labels: np.ndarray,
+                   batch_size: int, *, epochs: int = 1, drop_last: bool = True):
+    """Minimal epoch iterator (reference uses a bs=1 DataLoader,
+    ``train_fsdp.py:72``; batching is a knob here)."""
+    n = len(input_ids)
+    for _ in range(epochs):
+        for i in range(0, n - (batch_size - 1 if drop_last else 0),
+                       batch_size):
+            yield input_ids[i:i + batch_size], labels[i:i + batch_size]

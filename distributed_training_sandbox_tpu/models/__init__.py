@@ -1,1 +1,4 @@
 from .mlp import init_mlp, mlp_apply, zero_toy_mlp, pp_toy_mlp  # noqa: F401
+from .transformer import (  # noqa: F401
+    TransformerConfig, SMOLLM3_3B, SMOLLM3_350M, TINY_LM,
+    init_params, forward, lm_loss, model_flops_per_token)

@@ -1,0 +1,281 @@
+"""Decoder-only transformer LM, the "real model" of the framework.
+
+Twin of the reference's FSDP/fp8 model path, which instantiates
+SmolLM3-3B-class HF causal LMs from config with random init, bf16,
+``use_cache=False`` (reference ``fsdp/train_fsdp.py:61-64``,
+``fp8/fp8_benchmark.py:34-44``).  Here the model is a pure-functional JAX
+pytree so every parallelism strategy (DDP / ZeRO / FSDP / PP / quantized)
+can manipulate params directly:
+
+  * SmolLM3-class architecture: RMSNorm, rotary attention with a NoPE
+    interval (every 4th layer skips RoPE), grouped-query attention, gated
+    SwiGLU MLP, tied embeddings.
+  * **Scanned layers**: per-layer params are stacked on a leading axis and
+    the forward runs ``lax.scan`` over them — one compiled layer body
+    regardless of depth (compile time and HLO size stay O(1) in layers, and
+    FSDP-style per-layer gathers become one collective inside the scan body).
+  * ``jax.checkpoint`` around the scan body = the reference's
+    activation-memory story (README.md:26-33): only per-layer boundaries
+    are live across the backward.
+  * Attention impl selectable: "xla" (einsum + causal mask — runs anywhere,
+    XLA fuses on TPU) or "flash" (fused Pallas TPU kernel, the MXU/HBM-
+    friendly path for seq 8192).
+
+Shapes use (batch, seq, hidden) with weights stored (in, out) so the hot
+matmuls are plain ``x @ w`` on the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 128_256
+    hidden_size: int = 2048
+    intermediate_size: int = 11_008
+    num_hidden_layers: int = 36
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 4
+    head_dim: int | None = None
+    rope_theta: float = 5_000_000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = True
+    # Every nope_interval-th layer (0-indexed: layers where (i+1) % interval
+    # == 0) skips RoPE — SmolLM3's NoPE scheme.  0 disables (RoPE everywhere).
+    nope_interval: int = 4
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attention_impl: str = "xla"  # "xla" | "flash"
+    gated_mlp: bool = True  # duck-types as FlopsConfig for utils.flops
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    def param_count(self) -> int:
+        h, hd = self.hidden_size, self.resolved_head_dim
+        attn = h * hd * (self.num_attention_heads * 2
+                         + self.num_key_value_heads * 2)
+        mlp = 3 * h * self.intermediate_size
+        norms = 2 * h
+        per_layer = attn + mlp + norms
+        embed = self.vocab_size * h
+        head = 0 if self.tie_word_embeddings else embed
+        return self.num_hidden_layers * per_layer + embed + head + h
+
+
+# SmolLM3-3B-class config (~3.1 B params), the reference's FSDP benchmark
+# model (fsdp/train_fsdp.py:61-64).
+SMOLLM3_3B = TransformerConfig()
+
+# Smaller siblings for 1-chip benches and CI (same shape family).
+SMOLLM3_350M = TransformerConfig(
+    vocab_size=49_152, hidden_size=960, intermediate_size=2560,
+    num_hidden_layers=32, num_attention_heads=15, num_key_value_heads=5,
+    head_dim=64)
+TINY_LM = TransformerConfig(
+    vocab_size=512, hidden_size=64, intermediate_size=160,
+    num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+    rope_theta=10_000.0, dtype=jnp.float32, remat=False)
+
+
+# ------------------------------------------------------------------- init
+
+def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
+    """Random init from config — the reference never loads checkpoints
+    (``fsdp/train_fsdp.py:61-64``), so neither does the default path here.
+    Truncated-normal 0.02 (HF default), out-projections scaled by
+    1/sqrt(2·layers) for depth-stable residuals."""
+    h = cfg.hidden_size
+    hd = cfg.resolved_head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    L = cfg.num_hidden_layers
+    keys = iter(jax.random.split(key, 16))
+
+    def tn(k, shape, std=0.02):
+        return (std * jax.random.truncated_normal(k, -2, 2, shape,
+                                                  jnp.float32)
+                ).astype(cfg.dtype)
+
+    out_std = 0.02 / math.sqrt(2 * L)
+    params = {
+        "embed": tn(next(keys), (cfg.vocab_size, h)),
+        "layers": {
+            "ln1": jnp.ones((L, h), cfg.dtype),
+            "wq": tn(next(keys), (L, h, nq * hd)),
+            "wk": tn(next(keys), (L, h, nkv * hd)),
+            "wv": tn(next(keys), (L, h, nkv * hd)),
+            "wo": tn(next(keys), (L, nq * hd, h), out_std),
+            "ln2": jnp.ones((L, h), cfg.dtype),
+            "w_gate": tn(next(keys), (L, h, cfg.intermediate_size)),
+            "w_up": tn(next(keys), (L, h, cfg.intermediate_size)),
+            "w_down": tn(next(keys), (L, cfg.intermediate_size, h), out_std),
+        },
+        "final_norm": jnp.ones((h,), cfg.dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = tn(next(keys), (h, cfg.vocab_size))
+    return params
+
+
+# ---------------------------------------------------------------- building blocks
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * w.astype(jnp.float32)).astype(dt)
+
+
+def _rope_tables(seq_len: int, head_dim: int, theta: float):
+    inv_freq = 1.0 / theta ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim)
+    ang = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * inv_freq[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (B, S, n_heads, head_dim); split-half rotation (HF convention)."""
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(dt)
+
+
+def _attention_xla(q, k, v, scale: float) -> jax.Array:
+    """Plain causal attention: (B, S, n, hd) → (B, S, n, hd).  Scores in
+    fp32 (the numerically load-bearing part); XLA fuses mask+softmax."""
+    B, S, nq, hd = q.shape
+    nkv = k.shape[2]
+    if nq != nkv:  # GQA: repeat kv heads
+        rep = nq // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqnh,bknh->bnqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bknh->bqnh", probs, v)
+
+
+def _attention_flash(q, k, v, scale: float) -> jax.Array:
+    """Fused Pallas TPU flash attention (jax.experimental.pallas.ops.tpu).
+    Never materializes the S×S score matrix in HBM — the seq-8192 path."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention)
+    nq, nkv = q.shape[2], k.shape[2]
+    if nq != nkv:
+        rep = nq // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # kernel wants (B, n, S, hd)
+    out = flash_attention(
+        q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
+        causal=True, sm_scale=scale)
+    return out.swapaxes(1, 2)
+
+
+def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):
+    """One decoder layer.  ``layer`` holds this layer's (unstacked) params;
+    ``use_rope`` is a traced bool scalar (NoPE schedule)."""
+    B, S, h = x.shape
+    hd = cfg.resolved_head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+
+    r = rms_norm(x, layer["ln1"], cfg.rms_norm_eps)
+    q = (r @ layer["wq"]).reshape(B, S, nq, hd)
+    k = (r @ layer["wk"]).reshape(B, S, nkv, hd)
+    v = (r @ layer["wv"]).reshape(B, S, nkv, hd)
+    q = jnp.where(use_rope, apply_rope(q, cos, sin), q)
+    k = jnp.where(use_rope, apply_rope(k, cos, sin), k)
+    scale = 1.0 / math.sqrt(hd)
+    if cfg.attention_impl == "flash":
+        attn = _attention_flash(q, k, v, scale).astype(x.dtype)
+    else:
+        attn = _attention_xla(q, k, v, scale).astype(x.dtype)
+    x = x + attn.reshape(B, S, nq * hd) @ layer["wo"]
+
+    r = rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
+    mlp = (jax.nn.silu(r @ layer["w_gate"]) * (r @ layer["w_up"])
+           ) @ layer["w_down"]
+    return x + mlp
+
+
+def _rope_flags(cfg: TransformerConfig) -> jax.Array:
+    """Per-layer use-RoPE flags: SmolLM3 drops RoPE on every
+    ``nope_interval``-th layer."""
+    idx = jnp.arange(cfg.num_hidden_layers)
+    if cfg.nope_interval:
+        return (idx + 1) % cfg.nope_interval != 0
+    return jnp.ones_like(idx, dtype=jnp.bool_)
+
+
+# ---------------------------------------------------------------- forward
+
+def forward(params: dict, input_ids: jax.Array, cfg: TransformerConfig,
+            *, layer_hook=None) -> jax.Array:
+    """``input_ids`` (B, S) int32 → logits (B, S, vocab) in cfg.dtype.
+
+    ``layer_hook(layer_params) -> layer_params`` runs inside the scan body
+    *before* the layer computes — the seam where ZeRO-3/FSDP materialize
+    full params from shards (the JAX twin of the reference's module
+    forward-pre hooks, ``zero/zero3.py:56-77``).  Because the scan body is
+    rematerialized, the hook (and its all_gather) re-runs in the backward
+    pass, reproducing the backward pre-hook re-gather.
+    """
+    B, S = input_ids.shape
+    x = params["embed"].astype(cfg.dtype)[input_ids]
+    cos, sin = _rope_tables(S, cfg.resolved_head_dim, cfg.rope_theta)
+    flags = _rope_flags(cfg)
+
+    def body(carry, scanned):
+        layer, use_rope = scanned
+        if layer_hook is not None:
+            layer = layer_hook(layer)
+        return _layer_body(carry, layer, cfg=cfg, cos=cos, sin=sin,
+                           use_rope=use_rope), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    x, _ = lax.scan(body, x, (params["layers"], flags))
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    w_out = params.get("lm_head")
+    if w_out is None:
+        w_out = params["embed"].astype(cfg.dtype).T
+    return x @ w_out
+
+
+def lm_loss(params: dict, batch, cfg: TransformerConfig,
+            *, layer_hook=None) -> jax.Array:
+    """Causal-LM cross-entropy.  ``batch`` = (input_ids, labels) both (B, S),
+    the packed-window contract of the reference's TinyStories pipeline
+    (``fsdp/utils.py:58-89``: inputs = window[:-1], labels = window[1:]).
+    Log-softmax in fp32 — the reference's documented logit/log-prob memory
+    spike (README.md:28-33) is the same fp32 (B, S, vocab) tensor here.
+    """
+    input_ids, labels = batch
+    logits = forward(params, input_ids, cfg, layer_hook=layer_hook)
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None],
+                               axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def model_flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:
+    from ..utils.flops import get_model_flops_per_token
+    return get_model_flops_per_token(cfg, seq_len)

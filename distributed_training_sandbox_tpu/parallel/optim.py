@@ -37,9 +37,16 @@ def adam_update(grads, state: AdamState, params, *, lr=1e-3, b1=0.9, b2=0.999,
     c = count.astype(jnp.float32)
     bc1 = 1 - b1 ** c
     bc2 = 1 - b2 ** c
-    new_params = jax.tree.map(
-        lambda p, m, v: p - lr * (m / bc1) / (jnp.sqrt(v / bc2) + eps),
-        params, mu, nu)
+
+    def upd(p, m, v):
+        # fp32 math, cast back: keeps bf16 params bf16 (a silent f32
+        # promotion here changes the train-step's input types and forces
+        # a retrace-and-fail on step 2).
+        step = lr * (m.astype(jnp.float32) / bc1) / (
+            jnp.sqrt(v.astype(jnp.float32) / bc2) + eps)
+        return (p.astype(jnp.float32) - step).astype(p.dtype)
+
+    new_params = jax.tree.map(upd, params, mu, nu)
     return new_params, AdamState(mu=mu, nu=nu, count=count)
 
 

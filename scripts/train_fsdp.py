@@ -1,0 +1,134 @@
+"""Fully-sharded pretraining of the real transformer LM — runnable twin of
+reference ``fsdp/train_fsdp.py``.
+
+Same flow: model from config (random init, bf16), TinyStories packed dataset
+(synthetic fallback offline), per-layer shard/gather (ZeRO-3) or persisted
+gather (ZeRO-2) via ``--no-reshard-after-forward``, AdamW-on-shards,
+warmup-aware PerformanceTracker (tokens/s + TFLOPS/device), rank-0 profiler
+(wait=5 warmup=5 active=10 — reference ``fsdp/train_fsdp.py:124-137``).
+
+Usage:
+  python scripts/train_fsdp.py --num-steps 20 --sequence-length 8192 \
+      [--model smollm3-3b|smollm3-350m|tiny] [--variant explicit|auto] \
+      [--no-reshard-after-forward] [--cpu-devices 8] [--batch-size N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+MODELS = {"smollm3-3b": "SMOLLM3_3B", "smollm3-350m": "SMOLLM3_350M",
+          "tiny": "TINY_LM"}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--model", choices=sorted(MODELS), default="tiny")
+    p.add_argument("--variant", choices=["explicit", "auto"],
+                   default="explicit")
+    p.add_argument("--no-reshard-after-forward", dest="reshard",
+                   action="store_false", default=True)
+    p.add_argument("--attention", choices=["xla", "flash"], default=None)
+    args, rest = p.parse_known_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    import jax
+    import jax.numpy as jnp
+    from distributed_training_sandbox_tpu.utils import (
+        TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
+        PerformanceTracker, print_memory_stats, annotate)
+    from distributed_training_sandbox_tpu.utils.flops import (
+        get_model_flops_per_token)
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.parallel import fsdp
+    from distributed_training_sandbox_tpu.data import (
+        make_packed_dataset, packed_batches)
+
+    def flag_given(flag):
+        return any(r == flag or r.startswith(flag + "=") for r in rest or [])
+
+    cfg = TrainConfig.from_args(rest)
+    if not flag_given("--sequence-length"):
+        cfg.sequence_length = 256 if args.model == "tiny" else 8192
+    mcfg: T.TransformerConfig = getattr(T, MODELS[args.model])
+    if args.attention:
+        mcfg = dataclasses.replace(mcfg, attention_impl=args.attention)
+    mesh = make_mesh()
+    ws = get("ws")
+    # global batch = 1 per device by default (reference's bs=1 dataloader,
+    # train_fsdp.py:72); must stay divisible by the dp axis.
+    if not flag_given("--batch-size"):
+        cfg.batch_size = ws
+    if cfg.batch_size % ws:
+        raise SystemExit(f"--batch-size {cfg.batch_size} must be divisible "
+                         f"by device count {ws}")
+    print(f"[fsdp] model={args.model} ({mcfg.param_count()/1e9:.3f}B) "
+          f"variant={args.variant} reshard_after_forward={args.reshard} "
+          f"mesh={dict(mesh.shape)} platform={jax.devices()[0].platform}")
+
+    key = set_seed(cfg.seed)
+    params = T.init_params(key, mcfg)
+    shards = fsdp.shard_params_fsdp(params, mesh)
+    del params
+    opt_state = fsdp.init_fsdp_opt_state(shards)
+    print_memory_stats("fsdp-at-rest", params=shards, opt_state=opt_state)
+
+    if args.variant == "explicit":
+        step = fsdp.make_fsdp_train_step(
+            shards, mcfg, mesh, reshard_after_forward=args.reshard)
+    else:
+        step = fsdp.make_fsdp_auto_train_step(shards, mcfg, mesh)
+
+    input_ids, labels = make_packed_dataset(
+        cfg.sequence_length, mcfg.vocab_size,
+        num_tokens=max(cfg.batch_size * cfg.num_steps, 8)
+        * (cfg.sequence_length + 1))
+    print(f"[fsdp] dataset: {len(input_ids)} windows of "
+          f"{cfg.sequence_length} tokens")
+
+    flops_tok = get_model_flops_per_token(mcfg, cfg.sequence_length)
+    tracker = PerformanceTracker(
+        warmup_steps=min(5, max(cfg.num_steps - 1, 0)),
+        flops_per_token=flops_tok)
+    prof = Profiler(trace_dir=cfg.trace_dir,
+                    schedule=ProfileSchedule(skip_first=0, wait=5, warmup=5,
+                                             active=10)) if cfg.profile else None
+
+    metrics = None
+    tokens_per_step = cfg.batch_size * cfg.sequence_length
+    batches = packed_batches(input_ids, labels, cfg.batch_size,
+                             epochs=cfg.num_epochs * cfg.num_steps)
+    for i in range(cfg.num_steps):
+        with annotate("data_movement"):
+            bi, bl = next(batches)
+            batch = (jnp.asarray(bi), jnp.asarray(bl))
+        shards, opt_state, loss = step(shards, opt_state, batch)
+        jax.block_until_ready(loss)
+        metrics = tracker.step(tokens_per_step, loss=float(loss))
+        if prof:
+            prof.step()
+        if i % 5 == 0 or i == cfg.num_steps - 1:
+            print(f"[fsdp] step {i:3d} loss {float(loss):.4f}")
+    if prof:
+        prof.stop()
+
+    print_memory_stats("fsdp-final", params=shards, opt_state=opt_state)
+    if metrics:
+        print(f"[fsdp] tokens/s {metrics['tokens_per_second']:.1f} "
+              f"steps/s {metrics['steps_per_second']:.3f} "
+              f"TFLOPS/dev {metrics.get('tflops_per_device', 0):.2f} "
+              f"avg_loss {metrics.get('avg_loss', float('nan')):.4f}")
+    return metrics
+
+
+if __name__ == "__main__":
+    main()
