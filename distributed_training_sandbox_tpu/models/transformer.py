@@ -54,7 +54,18 @@ class TransformerConfig:
     nope_interval: int = 4
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "full" recomputes the whole layer in backward; "save_attn" keeps each
+    # layer's attention output resident (+S·nq·hd bf16 per layer) so the
+    # fused-attention forward doesn't run twice — the rematerialisation
+    # trade the reference's reshard_after_forward comments gesture at
+    # (fsdp/train_fsdp.py:84-88), applied to FLOPs instead of gathers.
+    remat_policy: str = "full"  # "full" | "save_attn"
     attention_impl: str = "xla"  # "xla" | "flash"
+    # Cross-entropy vocab chunk: None materializes full (B, S, vocab) fp32
+    # logits (the reference's documented ~4 GB spikes, README.md:28-33);
+    # an int streams the vocab through an online logsumexp in chunks of
+    # that size, capping loss memory at B·S·chunk fp32.
+    loss_vocab_chunk: int | None = None
     gated_mlp: bool = True  # duck-types as FlopsConfig for utils.flops
 
     @property
@@ -76,6 +87,12 @@ class TransformerConfig:
 # SmolLM3-3B-class config (~3.1 B params), the reference's FSDP benchmark
 # model (fsdp/train_fsdp.py:61-64).
 SMOLLM3_3B = TransformerConfig()
+
+# Single-chip flagship: the 3B architecture (same hidden/heads/vocab/MLP
+# geometry, so per-layer compute is identical) truncated to 8 layers to fit
+# one 16 GB v5e with AdamW state; fused attention + streamed vocab loss.
+SMOLLM3_3B_L8 = TransformerConfig(
+    num_hidden_layers=8, attention_impl="flash", loss_vocab_chunk=16_032)
 
 # Smaller siblings for 1-chip benches and CI (same shape family).
 SMOLLM3_350M = TransformerConfig(
@@ -172,20 +189,35 @@ def _attention_xla(q, k, v, scale: float) -> jax.Array:
 
 
 def _attention_flash(q, k, v, scale: float) -> jax.Array:
-    """Fused Pallas TPU flash attention (jax.experimental.pallas.ops.tpu).
-    Never materializes the S×S score matrix in HBM — the seq-8192 path."""
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        flash_attention)
-    nq, nkv = q.shape[2], k.shape[2]
-    if nq != nkv:
-        rep = nq // nkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    # kernel wants (B, n, S, hd)
-    out = flash_attention(
-        q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
-        causal=True, sm_scale=scale)
-    return out.swapaxes(1, 2)
+    """Fused Pallas TPU attention (splash kernel): never materializes the
+    S×S score matrix in HBM, handles GQA natively (no kv repeat), causal
+    blocks skipped above the diagonal.  Block sizes 512/1024 measured ~2×
+    over the kernel defaults at seq 8192 on v5e.  The seq-8192 path."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk, splash_attention_mask as sm)
+    B, S, nq, hd = q.shape
+    if S % 128:
+        # splash blocks must be lane-aligned (multiples of 128); odd
+        # lengths take the einsum path instead of crashing in the kernel.
+        return _attention_xla(q, k, v, scale)
+    bq, bkv = min(512, S), min(1024, S)
+    # block_kv_compute must itself be a multiple of 128
+    bkv_c = bkv // 2 if bkv % 256 == 0 else bkv
+    mask = sm.MultiHeadMask([sm.CausalMask((S, S)) for _ in range(nq)])
+    kernel = sk.make_splash_mha_single_device(
+        mask=mask,
+        block_sizes=sk.BlockSizes(
+            block_q=bq, block_kv=bkv, block_kv_compute=bkv_c,
+            block_q_dkv=bq, block_kv_dkv=bkv,
+            block_kv_dkv_compute=bkv_c,
+            block_q_dq=bq, block_kv_dq=bkv))
+
+    def one(q1, k1, v1):  # (S, n, hd) -> kernel layout (n, S, hd)
+        out = kernel(q1.swapaxes(0, 1) * scale, k1.swapaxes(0, 1),
+                     v1.swapaxes(0, 1))
+        return out.swapaxes(0, 1)
+
+    return jax.vmap(one)(q, k, v)
 
 
 def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):
@@ -206,6 +238,8 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):
         attn = _attention_flash(q, k, v, scale).astype(x.dtype)
     else:
         attn = _attention_xla(q, k, v, scale).astype(x.dtype)
+    from jax.ad_checkpoint import checkpoint_name
+    attn = checkpoint_name(attn, "attn_out")
     x = x + attn.reshape(B, S, nq * hd) @ layer["wo"]
 
     r = rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
@@ -236,6 +270,13 @@ def forward(params: dict, input_ids: jax.Array, cfg: TransformerConfig,
     rematerialized, the hook (and its all_gather) re-runs in the backward
     pass, reproducing the backward pre-hook re-gather.
     """
+    x = hidden_states(params, input_ids, cfg, layer_hook=layer_hook)
+    return x @ _output_embedding(params, cfg).T
+
+
+def hidden_states(params: dict, input_ids: jax.Array,
+                  cfg: TransformerConfig, *, layer_hook=None) -> jax.Array:
+    """Trunk only: (B, S) ids → final-norm hidden states (B, S, H)."""
     B, S = input_ids.shape
     x = params["embed"].astype(cfg.dtype)[input_ids]
     cos, sin = _rope_tables(S, cfg.resolved_head_dim, cfg.rope_theta)
@@ -249,14 +290,60 @@ def forward(params: dict, input_ids: jax.Array, cfg: TransformerConfig,
                            use_rope=use_rope), None
 
     if cfg.remat:
-        body = jax.checkpoint(body, prevent_cse=False)
+        policy = (jax.checkpoint_policies.save_only_these_names("attn_out")
+                  if cfg.remat_policy == "save_attn" else None)
+        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
     x, _ = lax.scan(body, x, (params["layers"], flags))
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    w_out = params.get("lm_head")
-    if w_out is None:
-        w_out = params["embed"].astype(cfg.dtype).T
-    return x @ w_out
+
+def _output_embedding(params: dict, cfg: TransformerConfig) -> jax.Array:
+    """Unembedding as (vocab, H) rows (tied: the input embedding itself)."""
+    w = params.get("lm_head")
+    if w is None:
+        return params["embed"].astype(cfg.dtype)
+    return w.astype(cfg.dtype).T
+
+
+def chunked_softmax_xent(x: jax.Array, w_vocab: jax.Array,
+                         labels: jax.Array, chunk: int) -> jax.Array:
+    """Mean cross-entropy of ``x @ w_vocab.T`` against ``labels`` without
+    ever materializing the (B, S, vocab) logits: stream vocab-row chunks
+    through an online (running max/sum) logsumexp, gathering the gold logit
+    as its chunk passes.  ``jax.checkpoint`` on the chunk body keeps the
+    backward at one chunk of logits too.  This removes all three of the
+    reference's ~4 GB fp32 spikes (logits, log-probs, grad-wrt-log-probs —
+    README.md:28-33) at once."""
+    V, H = w_vocab.shape
+    n_chunks = -(-V // chunk)
+    pad = n_chunks * chunk - V
+    if pad:
+        w_vocab = jnp.pad(w_vocab, ((0, pad), (0, 0)))
+    B, S, _ = x.shape
+
+    def body(carry, c):
+        m, s, gold = carry
+        w_c = lax.dynamic_slice(w_vocab, (c * chunk, 0), (chunk, H))
+        logits = jnp.einsum("bsh,vh->bsv", x, w_c,
+                            preferred_element_type=jnp.float32)
+        col = c * chunk + jnp.arange(chunk)
+        logits = jnp.where(col < V, logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[..., None]), axis=-1)
+        idx = labels - c * chunk
+        hit = (idx >= 0) & (idx < chunk)
+        g = jnp.take_along_axis(logits, jnp.clip(idx, 0, chunk - 1)[..., None],
+                                axis=-1)[..., 0]
+        gold = gold + jnp.where(hit, g, 0.0)
+        return (m_new, s, gold), None
+
+    init = (jnp.full((B, S), -jnp.inf, jnp.float32),
+            jnp.zeros((B, S), jnp.float32),
+            jnp.zeros((B, S), jnp.float32))
+    (m, s, gold), _ = lax.scan(jax.checkpoint(body, prevent_cse=False),
+                               init, jnp.arange(n_chunks))
+    return jnp.mean(jnp.log(s) + m - gold)
 
 
 def lm_loss(params: dict, batch, cfg: TransformerConfig,
@@ -264,10 +351,17 @@ def lm_loss(params: dict, batch, cfg: TransformerConfig,
     """Causal-LM cross-entropy.  ``batch`` = (input_ids, labels) both (B, S),
     the packed-window contract of the reference's TinyStories pipeline
     (``fsdp/utils.py:58-89``: inputs = window[:-1], labels = window[1:]).
-    Log-softmax in fp32 — the reference's documented logit/log-prob memory
-    spike (README.md:28-33) is the same fp32 (B, S, vocab) tensor here.
+
+    With ``cfg.loss_vocab_chunk`` unset this is the reference-faithful dense
+    path: fp32 log-softmax over full (B, S, vocab) logits — the same memory
+    spike the reference documents (README.md:28-33).  Set it to stream the
+    vocab instead (see chunked_softmax_xent).
     """
     input_ids, labels = batch
+    if cfg.loss_vocab_chunk:
+        x = hidden_states(params, input_ids, cfg, layer_hook=layer_hook)
+        return chunked_softmax_xent(x, _output_embedding(params, cfg),
+                                    labels, cfg.loss_vocab_chunk)
     logits = forward(params, input_ids, cfg, layer_hook=layer_hook)
     logits = logits.astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)

@@ -22,8 +22,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-MODELS = {"smollm3-3b": "SMOLLM3_3B", "smollm3-350m": "SMOLLM3_350M",
-          "tiny": "TINY_LM"}
+MODELS = {"smollm3-3b": "SMOLLM3_3B", "smollm3-3b-l8": "SMOLLM3_3B_L8",
+          "smollm3-350m": "SMOLLM3_350M", "tiny": "TINY_LM"}
 
 
 def main(argv=None):
@@ -35,6 +35,8 @@ def main(argv=None):
     p.add_argument("--no-reshard-after-forward", dest="reshard",
                    action="store_false", default=True)
     p.add_argument("--attention", choices=["xla", "flash"], default=None)
+    p.add_argument("--remat-policy", choices=["full", "save_attn"],
+                   default=None)
     args, rest = p.parse_known_args(argv)
 
     if args.cpu_devices:
@@ -62,6 +64,8 @@ def main(argv=None):
     mcfg: T.TransformerConfig = getattr(T, MODELS[args.model])
     if args.attention:
         mcfg = dataclasses.replace(mcfg, attention_impl=args.attention)
+    if args.remat_policy:
+        mcfg = dataclasses.replace(mcfg, remat_policy=args.remat_policy)
     mesh = make_mesh()
     ws = get("ws")
     # global batch = 1 per device by default (reference's bs=1 dataloader,

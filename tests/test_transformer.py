@@ -123,3 +123,45 @@ def test_synthetic_stream_deterministic_and_skewed():
     counts = np.bincount(a, minlength=256)
     # Zipf: most-frequent token much more common than the tail
     assert counts[np.argsort(counts)[-1]] > 5 * counts[counts > 0].mean()
+
+
+def test_chunked_loss_matches_dense(setup):
+    """Streamed-vocab cross-entropy == dense fp32 log-softmax, for chunk
+    sizes that do and don't divide the vocab (padding + mask path)."""
+    params, batch = setup
+    dense = jax.jit(jax.value_and_grad(lambda p, b: T.lm_loss(p, b, CFG)))
+    l0, g0 = dense(params, batch)
+    for chunk in (100, 512):
+        cfg_c = dataclasses.replace(CFG, loss_vocab_chunk=chunk)
+        l1, g1 = jax.jit(jax.value_and_grad(
+            lambda p, b: T.lm_loss(p, b, cfg_c)))(params, batch)
+        assert float(l1) == pytest.approx(float(l0), abs=1e-5)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32), atol=1e-6)
+
+
+def test_chunked_softmax_xent_direct():
+    from distributed_training_sandbox_tpu.models.transformer import (
+        chunked_softmax_xent)
+    key = jax.random.PRNGKey(3)
+    x = jax.random.normal(key, (2, 8, 16))
+    w = jax.random.normal(jax.random.PRNGKey(4), (37, 16))  # odd vocab
+    labels = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0, 37)
+    logits = x @ w.T
+    want = float(jnp.mean(jax.scipy.special.logsumexp(logits, -1)
+                          - jnp.take_along_axis(logits, labels[..., None],
+                                                -1)[..., 0]))
+    got = float(chunked_softmax_xent(x, w, labels, chunk=10))
+    assert got == pytest.approx(want, abs=1e-5)
+
+
+def test_save_attn_remat_policy_matches(setup):
+    params, batch = setup
+    cfg_s = dataclasses.replace(CFG, remat=True, remat_policy="save_attn")
+    base = float(jax.jit(lambda p, b: T.lm_loss(p, b, CFG))(params, batch))
+    saved = float(jax.jit(lambda p, b: T.lm_loss(p, b, cfg_s))(params, batch))
+    assert saved == pytest.approx(base, abs=1e-5)
+    g = jax.jit(jax.grad(lambda p, b: T.lm_loss(p, b, cfg_s)))(params, batch)
+    assert all(np.isfinite(np.asarray(x, np.float32)).all()
+               for x in jax.tree.leaves(g))
