@@ -83,13 +83,24 @@ def reference_tflops_per_device() -> float:
     return REF_TOK_S * ft / REF_DEVICES / 1e12
 
 
+def _tpu_available() -> bool:
+    """Probe for a TPU in a subprocess: checking in-process would
+    initialize the backend and make a later use_cpu_devices() a no-op."""
+    import subprocess
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        capture_output=True, text=True, timeout=120)
+    return r.stdout.strip().splitlines()[-1:] == ["tpu"]
+
+
 def main():
-    import jax
     tiers = [("SMOLLM3_3B_L8", SEQ, 2), ("SMOLLM3_350M", SEQ, 4)]
-    if jax.devices()[0].platform != "tpu":
+    if not _tpu_available():
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(8)
         tiers = [("TINY_LM", 256, 8)]
+    import jax
     result = None
     errors = []
     for model, seq, bs in tiers:

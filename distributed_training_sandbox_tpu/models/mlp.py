@@ -41,13 +41,22 @@ def init_mlp(key: jax.Array, sizes, dtype=jnp.float32) -> list[dict]:
     return params
 
 
-def mlp_apply(params: list[dict], x: jax.Array) -> jax.Array:
-    """ReLU between layers, none after the last (nn.Sequential twin)."""
+def mlp_apply_stage(params: list[dict], x: jax.Array,
+                    *, last_stage: bool = False) -> jax.Array:
+    """Apply a (slice of a) layered MLP: ReLU after every layer except the
+    final layer of the last stage.  A non-final pipeline stage keeps the
+    ReLU after its last layer too — splitting nn.Sequential keeps the
+    activation modules with their chunk (reference ``pp/gpipe.py:38-47``)."""
     for i, layer in enumerate(params):
         x = x @ layer["w"] + layer["b"]
-        if i < len(params) - 1:
+        if not (last_stage and i == len(params) - 1):
             x = jax.nn.relu(x)
     return x
+
+
+def mlp_apply(params: list[dict], x: jax.Array) -> jax.Array:
+    """ReLU between layers, none after the last (nn.Sequential twin)."""
+    return mlp_apply_stage(params, x, last_stage=True)
 
 
 def zero_toy_mlp(key: jax.Array, dtype=jnp.float32, scale: int = 1):

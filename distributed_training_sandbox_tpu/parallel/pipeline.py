@@ -1,0 +1,303 @@
+"""Pipeline parallelism: GPipe and 1F1B schedules, host-driven.
+
+Twin of reference ``pp/gpipe.py`` and ``pp/1f1b.py``: a layered toy MLP split
+into contiguous stages placed on different devices *in one process*, a
+host-side scheduler moving microbatch activations stage-to-stage, per-stage
+optimizers.  The reference's cross-stage hop is a CUDA peer copy
+(``gpipe.py:108``), not a collective — the twin here is an explicit
+``jax.device_put`` between stage devices (D2D over ICI on a TPU slice);
+the scheduler itself is pure host Python in both.
+
+Mechanics mapping:
+  * stage forward keeps the *input* microbatch (the reference keeps
+    ``x.detach().requires_grad_(True)``, ``1f1b.py:112-123``); the backward
+    re-runs the stage under ``jax.vjp`` on that stored input and applies the
+    incoming output-cotangent — functionally identical to
+    ``out.backward(gradient=grad_output)`` + relaying ``x.grad``
+    (``1f1b.py:137-156``), with recompute instead of a stored autograd graph.
+  * GPipe (`run_gpipe`): all forwards stage-by-stage draining deque queues
+    (``gpipe.py:92-115``), then all backwards in reverse microbatch order
+    (``:119-147``).
+  * 1F1B (`run_1f1b`): clock scheduler, ``ticks = n_micro + n_stages - 1``
+    (``1f1b.py:102``); per tick each stage does at most one forward and one
+    backward; the last stage enqueues its backward immediately after its
+    forward (``:130-131``), so peak stored activations ~n_stages instead of
+    ~n_microbatches (``1f1b.py:4-11``).
+  * last stage computes loss/n_micro (``gpipe.py:110-115``); gradients
+    accumulate across microbatches; per-stage Adam steps afterwards
+    (``gpipe.py:149-151``).
+
+Known-bug note: the reference's GPipe backward leans on a loop-leaked
+``out`` variable for device placement (``gpipe.py:126``, SURVEY.md §2.9.7);
+here every transfer is explicit.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..models.mlp import mlp_apply, mlp_apply_stage
+from ..utils.memory import device_memory_stats, MB
+from . import optim
+
+
+def split_stages(params: list, n_stages: int) -> list[list]:
+    """Contiguous layer chunks, remainder to the earlier stages — the twin
+    of slicing ``nn.Sequential`` into per-device chunks (``gpipe.py:38-47``,
+    6 layers over 2 stages -> 3+3)."""
+    n = len(params)
+    base, rem = divmod(n, n_stages)
+    out, start = [], 0
+    for s in range(n_stages):
+        size = base + (1 if s < rem else 0)
+        out.append(params[start:start + size])
+        start += size
+    return out
+
+
+class PipelineStage:
+    """One stage: its params pinned to a device + jitted fwd / bwd / loss
+    kernels.  ``apply_fn(stage_params, x)`` is the stage's forward."""
+
+    def __init__(self, stage_params, device: jax.Device,
+                 apply_fn: Callable = mlp_apply, is_last: bool = False,
+                 loss_fn: Callable | None = None):
+        self.device = device
+        self.params = jax.device_put(stage_params, device)
+        self.is_last = is_last
+        apply = apply_fn
+        loss = loss_fn or (lambda out, y: jnp.mean((out - y) ** 2))
+
+        def fwd(p, x):
+            return apply(p, x)
+
+        def bwd(p, x, gout):
+            _, vjp = jax.vjp(apply, p, x)
+            gp, gx = vjp(gout)
+            return gp, gx
+
+        def last_fwd_bwd(p, x, y, inv_n_micro):
+            def scaled(p, x):
+                return loss(apply(p, x), y) * inv_n_micro
+            (l, (gp, gx)) = jax.value_and_grad(scaled, argnums=(0, 1))(p, x)
+            return l, gp, gx
+
+        self.fwd = jax.jit(fwd)
+        self.bwd = jax.jit(bwd)
+        self.last_fwd_bwd = jax.jit(last_fwd_bwd)
+        # accumulated grads + stored fwd inputs (microbatch queue)
+        self.grad_acc = None
+        self.opt_state = optim.adam_init(self.params)
+        # high-water mark of concurrently stored activations — the
+        # observable form of 1F1B's ~n_stages vs GPipe's ~n_micro peak
+        # (1f1b.py:4-11) on substrates without allocator stats.
+        self.max_stored = 0
+
+    def accumulate(self, gp):
+        if self.grad_acc is None:
+            self.grad_acc = gp
+        else:
+            self.grad_acc = jax.tree.map(jnp.add, self.grad_acc, gp)
+
+    def step(self, lr: float = 1e-3):
+        """Per-stage Adam step (``gpipe.py:57,149-151``)."""
+        if self.grad_acc is None:
+            return
+        self.params, self.opt_state = optim.adam_update(
+            self.grad_acc, self.opt_state, self.params, lr=lr)
+        self.grad_acc = None
+
+    def peak_memory_mb(self) -> float:
+        return device_memory_stats(self.device)["peak_bytes_in_use"] / MB
+
+
+def build_pipeline(params: list, n_stages: int,
+                   devices: Sequence[jax.Device] | None = None,
+                   apply_fn: Callable | None = None,
+                   loss_fn: Callable | None = None) -> list[PipelineStage]:
+    """Split a layered model over ``n_stages`` devices (device i holds stage
+    i, cycling if fewer devices than stages — the reference requires
+    n_gpus == n_stages, ``gpipe.py:17-20``).  The default apply keeps
+    inter-stage ReLUs with their chunk (mlp_apply_stage); pass ``apply_fn``
+    for custom layer stacks (it is used as-is for every stage)."""
+    from functools import partial
+
+    devs = list(devices if devices is not None else jax.local_devices())
+    chunks = split_stages(params, n_stages)
+    stages = []
+    for s, chunk in enumerate(chunks):
+        is_last = s == n_stages - 1
+        apply = apply_fn or partial(mlp_apply_stage, last_stage=is_last)
+        stages.append(PipelineStage(chunk, devs[s % len(devs)], apply,
+                                    is_last=is_last, loss_fn=loss_fn))
+    return stages
+
+
+def _microbatch(x, y, n_micro: int):
+    if x.shape[0] % n_micro:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"n_micro={n_micro}")
+    return (jnp.split(x, n_micro), jnp.split(y, n_micro))
+
+
+def _to_stage(x, stage: PipelineStage):
+    """The cross-stage hop: explicit device transfer (``gpipe.py:106-109``,
+    ``.to(cuda:i+1, non_blocking=True)``)."""
+    return jax.device_put(x, stage.device)
+
+
+def run_gpipe(stages: list[PipelineStage], x, y, n_micro: int = 4,
+              lr: float = 1e-3) -> float:
+    """One GPipe step: all forwards, then all backwards, then per-stage
+    optimizer steps.  Returns the (already 1/n_micro-scaled, summed) batch
+    loss, as the reference accumulates it (``gpipe.py:110-115``)."""
+    n_stages = len(stages)
+    xs, ys = _microbatch(x, y, n_micro)
+    inv = jnp.float32(1.0 / n_micro)
+
+    fwd_q: list[deque] = [deque() for _ in range(n_stages)]
+    # stored (input, gout-cotangent placeholder) per stage per microbatch
+    stored: list[list] = [[] for _ in range(n_stages)]
+    for mb in range(n_micro):
+        fwd_q[0].append(jnp.asarray(xs[mb]))
+
+    # ---- all-forward phase, stage by stage (gpipe.py:92-115)
+    acts_last: list = []
+    for s, stage in enumerate(stages):
+        while fwd_q[s]:
+            xin = _to_stage(fwd_q[s].popleft(), stage)
+            stored[s].append(xin)
+            stage.max_stored = max(stage.max_stored, len(stored[s]))
+            if stage.is_last:
+                acts_last.append(xin)
+            else:
+                out = stage.fwd(stage.params, xin)
+                fwd_q[s + 1].append(out)
+
+    # ---- all-backward phase, reverse microbatch order (gpipe.py:119-147)
+    # losses stay device scalars until the end: a float() per microbatch
+    # would sync the host and serialize the cross-stage overlap
+    mb_losses = []
+    for mb in reversed(range(n_micro)):
+        yd = _to_stage(ys[mb], stages[-1])
+        l, gp, gx = stages[-1].last_fwd_bwd(
+            stages[-1].params, acts_last[mb], yd, inv)
+        stages[-1].accumulate(gp)
+        mb_losses.append(l)
+        g = gx
+        for s in range(n_stages - 2, -1, -1):
+            stage = stages[s]
+            g = _to_stage(g, stage)
+            gp, g = stage.bwd(stage.params, stored[s][mb], g)
+            stage.accumulate(gp)
+
+    for stage in stages:
+        stage.step(lr)
+    return float(jnp.sum(jnp.stack(mb_losses)))
+
+
+def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
+             lr: float = 1e-3) -> float:
+    """One 1F1B step: clock scheduler, ``ticks = n_micro + n_stages - 1``
+    (``1f1b.py:102``).  Each tick, each stage does at most one forward and
+    one backward; activations are freed as backwards consume them, so peak
+    stored microbatch inputs per stage ~n_stages (``1f1b.py:4-11``)."""
+    n_stages = len(stages)
+    xs, ys = _microbatch(x, y, n_micro)
+    inv = jnp.float32(1.0 / n_micro)
+
+    fwd_q: list[deque] = [deque() for _ in range(n_stages)]
+    bwd_q: list[deque] = [deque() for _ in range(n_stages)]
+    for mb in range(n_micro):
+        fwd_q[0].append((mb, jnp.asarray(xs[mb])))
+    stored: list[dict] = [dict() for _ in range(n_stages)]
+
+    mb_losses = []
+    ticks = n_micro + n_stages - 1
+    for _tick in range(ticks * 2):  # *2: fwd and bwd sub-slots interleave
+        progressed = False
+        for s, stage in enumerate(stages):
+            # one forward per tick per stage (1f1b.py:112-131)
+            if fwd_q[s]:
+                mb, xin = fwd_q[s].popleft()
+                xin = _to_stage(xin, stage)
+                stored[s][mb] = xin
+                stage.max_stored = max(stage.max_stored, len(stored[s]))
+                if stage.is_last:
+                    # last stage backs-prop immediately (1f1b.py:130-131)
+                    bwd_q[s].append((mb, None))
+                else:
+                    fwd_q[s + 1].append((mb, stage.fwd(stage.params, xin)))
+                progressed = True
+            # one backward per tick per stage (1f1b.py:134-158)
+            if bwd_q[s]:
+                mb, gout = bwd_q[s].popleft()
+                xin = stored[s].pop(mb)  # free the activation
+                if stage.is_last:
+                    yd = _to_stage(ys[mb], stage)
+                    l, gp, gx = stage.last_fwd_bwd(stage.params, xin, yd, inv)
+                    mb_losses.append(l)
+                else:
+                    gp, gx = stage.bwd(stage.params, xin,
+                                       _to_stage(gout, stage))
+                stage.accumulate(gp)
+                if s > 0:
+                    bwd_q[s - 1].append((mb, gx))
+                progressed = True
+        if not progressed and all(not q for q in fwd_q + bwd_q):
+            break
+
+    for stage in stages:
+        stage.step(lr)
+    return float(jnp.sum(jnp.stack(mb_losses)))
+
+
+@dataclass
+class PipeResult:
+    """JSON results schema twin of ``gpipe.py:205-218``."""
+    schedule: str
+    final_loss: float
+    avg_loss: float
+    total_time_s: float
+    avg_epoch_time_s: float
+    epochs_per_s: float
+    peak_memory_mb: dict = field(default_factory=dict)
+    total_peak_memory_mb: float = 0.0
+
+    def as_dict(self) -> dict:
+        return asdict(self)
+
+
+def train_pipeline(stages: list[PipelineStage], schedule: str,
+                   make_batch: Callable[[int], tuple],
+                   num_epochs: int, n_micro: int = 4,
+                   lr: float = 1e-3, log: Callable | None = None) -> PipeResult:
+    """Epoch loop + metrics, twin of the reference's ``__main__`` epoch loop
+    and JSON dump (``1f1b.py:186-205``, ``gpipe.py:205-218``)."""
+    run = {"gpipe": run_gpipe, "1f1b": run_1f1b}[schedule]
+    losses = []
+    t0 = time.perf_counter()
+    for epoch in range(num_epochs):
+        x, y = make_batch(epoch)
+        loss = run(stages, x, y, n_micro=n_micro, lr=lr)
+        losses.append(loss)
+        if log:
+            log(epoch, loss)
+    total = time.perf_counter() - t0
+    peaks = {f"device_{i}": s.peak_memory_mb() for i, s in enumerate(stages)}
+    return PipeResult(
+        schedule=schedule,
+        final_loss=losses[-1],
+        avg_loss=sum(losses) / len(losses),
+        total_time_s=total,
+        avg_epoch_time_s=total / num_epochs,
+        epochs_per_s=num_epochs / total,
+        peak_memory_mb=peaks,
+        total_peak_memory_mb=sum(peaks.values()),
+    )

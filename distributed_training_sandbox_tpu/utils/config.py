@@ -39,10 +39,13 @@ class TrainConfig:
 
     @classmethod
     def from_args(cls, argv=None, **overrides) -> "TrainConfig":
+        """CLI args win; ``overrides`` are script-specific *defaults* that
+        apply only where the user passed nothing."""
         ns, _ = build_argparser().parse_known_args(argv)
         kwargs = {f.name: getattr(ns, f.name) for f in fields(cls)
                   if hasattr(ns, f.name) and getattr(ns, f.name) is not None}
-        kwargs.update(overrides)
+        for k, v in overrides.items():
+            kwargs.setdefault(k, v)
         return cls(**kwargs)
 
 

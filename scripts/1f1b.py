@@ -1,0 +1,17 @@
+"""1F1B schedule on the toy MLP — runnable twin of reference ``pp/1f1b.py``:
+clock scheduler (ticks = n_micro + n_stages - 1), one forward and one
+backward per stage per tick, last stage backs-prop immediately, activations
+freed as consumed.
+
+Usage: python scripts/1f1b.py [--n-stages 2] [--n-micro 4] [--num-epochs 16]
+       [--cpu-devices 8] [--results-file out.json]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _pp_driver import main  # noqa: E402
+
+if __name__ == "__main__":
+    main("1f1b")

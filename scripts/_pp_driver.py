@@ -1,0 +1,76 @@
+"""Shared pipeline-parallel driver behind ``scripts/gpipe.py`` and
+``scripts/1f1b.py`` — the epoch loop, synthetic data, JSON results file and
+profiler of reference ``pp/gpipe.py:160-218`` / ``pp/1f1b.py:170-236``,
+factored once (the reference duplicates it per file, SURVEY.md §2.8)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(schedule: str, argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--n-stages", type=int, default=2)
+    p.add_argument("--n-micro", type=int, default=4)
+    p.add_argument("--results-file", type=str, default=None)
+    args, rest = p.parse_known_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    import jax
+    from distributed_training_sandbox_tpu.utils import (
+        TrainConfig, set_seed, Profiler, ProfileSchedule)
+    from distributed_training_sandbox_tpu.models import pp_toy_mlp
+    from distributed_training_sandbox_tpu.models.mlp import PP_TOY_SIZES
+    from distributed_training_sandbox_tpu.parallel.pipeline import (
+        build_pipeline, train_pipeline)
+
+    cfg = TrainConfig.from_args(rest, batch_size=64, num_epochs=16)
+    key = set_seed(cfg.seed)
+    params = pp_toy_mlp(key)
+    stages = build_pipeline(params, args.n_stages)
+    devs = [str(s.device) for s in stages]
+    print(f"[{schedule}] stages={args.n_stages} micro={args.n_micro} "
+          f"devices={devs}")
+
+    width_in, width_out = PP_TOY_SIZES[0], PP_TOY_SIZES[-1]
+
+    def make_batch(epoch):
+        # fresh synthetic batch per epoch (reference gpipe.py:175-176)
+        k = jax.random.fold_in(key, epoch)
+        kx, ky = jax.random.split(k)
+        return (jax.random.normal(kx, (cfg.batch_size, width_in)),
+                jax.random.normal(ky, (cfg.batch_size, width_out)))
+
+    prof = Profiler(trace_dir=cfg.trace_dir,
+                    schedule=ProfileSchedule(skip_first=2, wait=1, warmup=1,
+                                             active=4)) if cfg.profile else None
+
+    def log(epoch, loss):
+        if epoch % 4 == 0 or epoch == cfg.num_epochs - 1:
+            print(f"[{schedule}] epoch {epoch:3d} loss {loss:.6f}")
+        if prof:
+            prof.step()
+
+    result = train_pipeline(stages, schedule, make_batch,
+                            num_epochs=cfg.num_epochs, n_micro=args.n_micro,
+                            log=log)
+    if prof:
+        prof.stop()
+
+    out = result.as_dict()
+    out["max_stored_activations"] = {
+        f"stage_{i}": s.max_stored for i, s in enumerate(stages)}
+    print(f"[{schedule}] {json.dumps(out)}")
+    if args.results_file:
+        Path(args.results_file).write_text(json.dumps(out, indent=2))
+        print(f"[{schedule}] results -> {args.results_file}")
+    return out

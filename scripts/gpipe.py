@@ -1,0 +1,16 @@
+"""GPipe schedule on the toy MLP — runnable twin of reference
+``pp/gpipe.py``: all-forward then all-backward over microbatch queues,
+per-stage Adam, JSON results.
+
+Usage: python scripts/gpipe.py [--n-stages 2] [--n-micro 4] [--num-epochs 16]
+       [--cpu-devices 8] [--results-file out.json]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _pp_driver import main  # noqa: E402
+
+if __name__ == "__main__":
+    main("gpipe")

@@ -58,9 +58,8 @@ def main(argv=None):
     def flag_given(flag):
         return any(r == flag or r.startswith(flag + "=") for r in rest or [])
 
-    cfg = TrainConfig.from_args(rest)
-    if not flag_given("--sequence-length"):
-        cfg.sequence_length = 256 if args.model == "tiny" else 8192
+    cfg = TrainConfig.from_args(
+        rest, sequence_length=256 if args.model == "tiny" else 8192)
     mcfg: T.TransformerConfig = getattr(T, MODELS[args.model])
     if args.attention:
         mcfg = dataclasses.replace(mcfg, attention_impl=args.attention)
