@@ -87,10 +87,13 @@ def _tpu_available() -> bool:
     """Probe for a TPU in a subprocess: checking in-process would
     initialize the backend and make a later use_cpu_devices() a no-op."""
     import subprocess
-    r = subprocess.run(
-        [sys.executable, "-c",
-         "import jax; print(jax.devices()[0].platform)"],
-        capture_output=True, text=True, timeout=120)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=120)
+    except (subprocess.TimeoutExpired, OSError):
+        return False
     return r.stdout.strip().splitlines()[-1:] == ["tpu"]
 
 

@@ -1,4 +1,12 @@
 from .mlp import init_mlp, mlp_apply, zero_toy_mlp, pp_toy_mlp  # noqa: F401
 from .transformer import (  # noqa: F401
-    TransformerConfig, SMOLLM3_3B, SMOLLM3_350M, TINY_LM,
+    TransformerConfig, SMOLLM3_3B, SMOLLM3_3B_L8, SMOLLM3_350M, TINY_LM,
     init_params, forward, lm_loss, model_flops_per_token)
+
+# CLI name -> TransformerConfig attribute, shared by every script.
+MODEL_REGISTRY = {
+    "smollm3-3b": "SMOLLM3_3B",
+    "smollm3-3b-l8": "SMOLLM3_3B_L8",
+    "smollm3-350m": "SMOLLM3_350M",
+    "tiny": "TINY_LM",
+}

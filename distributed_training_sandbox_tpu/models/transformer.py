@@ -66,6 +66,11 @@ class TransformerConfig:
     # an int streams the vocab through an online logsumexp in chunks of
     # that size, capping loss memory at B·S·chunk fp32.
     loss_vocab_chunk: int | None = None
+    # Projection-matmul precision: "bf16", or int8 with dynamic absmax
+    # scaling (forward quantized, backward bf16) — the reference's fp8
+    # benchmark knob (fp8_benchmark.py:47) with v5e's native low-precision
+    # format.  "int8_pallas" routes through the hand-tiled Pallas kernel.
+    matmul_precision: str = "bf16"  # "bf16" | "int8" | "int8_pallas"
     gated_mlp: bool = True  # duck-types as FlopsConfig for utils.flops
 
     @property
@@ -220,17 +225,28 @@ def _attention_flash(q, k, v, scale: float) -> jax.Array:
     return jax.vmap(one)(q, k, v)
 
 
+def _dense(cfg: TransformerConfig):
+    """The projection matmul at the configured precision."""
+    if cfg.matmul_precision == "bf16":
+        return lambda a, w: a @ w
+    from ..ops import quant as Q
+    impl = "pallas" if cfg.matmul_precision == "int8_pallas" else "xla"
+    interp = jax.default_backend() != "tpu"
+    return lambda a, w: Q.quantized_dense(a, w, impl, interp)
+
+
 def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):
     """One decoder layer.  ``layer`` holds this layer's (unstacked) params;
     ``use_rope`` is a traced bool scalar (NoPE schedule)."""
     B, S, h = x.shape
     hd = cfg.resolved_head_dim
     nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    dense = _dense(cfg)
 
     r = rms_norm(x, layer["ln1"], cfg.rms_norm_eps)
-    q = (r @ layer["wq"]).reshape(B, S, nq, hd)
-    k = (r @ layer["wk"]).reshape(B, S, nkv, hd)
-    v = (r @ layer["wv"]).reshape(B, S, nkv, hd)
+    q = dense(r, layer["wq"]).reshape(B, S, nq, hd)
+    k = dense(r, layer["wk"]).reshape(B, S, nkv, hd)
+    v = dense(r, layer["wv"]).reshape(B, S, nkv, hd)
     q = jnp.where(use_rope, apply_rope(q, cos, sin), q)
     k = jnp.where(use_rope, apply_rope(k, cos, sin), k)
     scale = 1.0 / math.sqrt(hd)
@@ -240,11 +256,11 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):
         attn = _attention_xla(q, k, v, scale).astype(x.dtype)
     from jax.ad_checkpoint import checkpoint_name
     attn = checkpoint_name(attn, "attn_out")
-    x = x + attn.reshape(B, S, nq * hd) @ layer["wo"]
+    x = x + dense(attn.reshape(B, S, nq * hd), layer["wo"])
 
     r = rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
-    mlp = (jax.nn.silu(r @ layer["w_gate"]) * (r @ layer["w_up"])
-           ) @ layer["w_down"]
+    mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
+                * dense(r, layer["w_up"]), layer["w_down"])
     return x + mlp
 
 

@@ -13,3 +13,4 @@ from .collectives import (  # noqa: F401
     tree_all_reduce,
 )
 from .hlo import count_collectives, lowered_text  # noqa: F401
+from . import quant  # noqa: F401

@@ -1,0 +1,188 @@
+"""Low-precision (int8) matmul path — the TPU twin of the reference's FP8
+benchmark stack (``fp8/fp8_benchmark.py:61-92``: torchao Float8Linear with
+dynamic scaling under FSDP2).
+
+v5e has no fp8 units (SURVEY.md §7.3), so the honest low-precision twin is
+int8: the MXU multiplies int8×int8 into int32 at twice the bf16 rate.  The
+pieces, mirroring torchao's roles:
+
+  * dynamic **per-row absmax scaling** (`quantize_int8`) — the twin of
+    Float8Linear's dynamic scaling;
+  * `int8_matmul`: XLA path (``lax.dot_general`` with int32 accumulation);
+  * `int8_matmul_pallas`: the same contraction as a hand-tiled **Pallas
+    kernel** with the dequant fused into the epilogue — the repo's
+    native/kernel-level component (runs in interpreter mode off-TPU);
+  * `quantized_dense`: straight-through-estimator linear layer for
+    training (forward int8, backward bf16) — what Float8Linear does;
+  * `quantized_all_gather`: gather int8 shards + scales and dequantize
+    *after* the wire, the twin of torchao's
+    ``enable_fsdp_float8_all_gather`` (``fp8_benchmark.py:79-81``) — 4x
+    fewer bytes over ICI than a bf16 gather, with a full-precision
+    psum_scatter backward.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import collectives as C
+
+
+def quantize_int8(x: jax.Array, axis: int = -1):
+    """Symmetric per-row absmax int8 quantization along ``axis`` (the
+    contraction dim): returns (q int8, scale f32 with ``axis`` kept at 1).
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+# ------------------------------------------------------------------- XLA
+
+def int8_matmul(xq, xs, wq, ws, out_dtype=jnp.bfloat16):
+    """(M,K)int8 · (K,N)int8 → (M,N), int32 accumulation on the MXU, scales
+    applied in the epilogue.  xs: (M,1) f32, ws: (1,N) f32."""
+    acc = lax.dot_general(xq, wq, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * xs * ws).astype(out_dtype)
+
+
+# ---------------------------------------------------------------- pallas
+
+def _pick_block(dim: int, target: int, mult: int) -> int:
+    """Largest divisor of ``dim`` that is <= target and a multiple of
+    ``mult`` (TPU lowering wants sublane/lane-aligned blocks: second-minor
+    % 8, minor % 128 — or the whole dim)."""
+    if dim <= target:
+        return dim
+    b = target - target % mult
+    while b >= mult:
+        if dim % b == 0:
+            return b
+        b -= mult
+    return dim
+
+
+def _qmm_kernel(xq_ref, xs_ref, wq_ref, ws_ref, o_ref):
+    acc = jnp.dot(xq_ref[...], wq_ref[...],
+                  preferred_element_type=jnp.int32)
+    o_ref[...] = (acc.astype(jnp.float32) * xs_ref[...] * ws_ref[...]
+                  ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "block_m",
+                                             "block_n", "interpret"))
+def int8_matmul_pallas(xq, xs, wq, ws, *, out_dtype=jnp.bfloat16,
+                       block_m: int = 256, block_n: int = 512,
+                       interpret: bool = False):
+    """Tiled Pallas twin of `int8_matmul`: grid over (M/bm, N/bn), full-K
+    int8 blocks in VMEM, int32 MXU accumulation, fused dequant epilogue."""
+    from jax.experimental import pallas as pl
+
+    M, K = xq.shape
+    K2, N = wq.shape
+    assert K == K2, (K, K2)
+    bm, bn = _pick_block(M, block_m, 8), _pick_block(N, block_n, 128)
+    return pl.pallas_call(
+        _qmm_kernel,
+        grid=(M // bm, N // bn),
+        in_specs=[
+            pl.BlockSpec((bm, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((K, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        interpret=interpret,
+    )(xq, xs, wq, ws)
+
+
+# ------------------------------------------------------------- training
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def quantized_dense(x, w, impl: str = "xla", interpret: bool = False):
+    """Linear layer with int8 forward and straight-through bf16 backward —
+    the Float8Linear training recipe (quantize dynamically, matmul in low
+    precision, backprop in high precision).  ``x``: (..., K), ``w``: (K, N).
+    """
+    out, _ = _qdense_fwd(x, w, impl, interpret)
+    return out
+
+
+def _qdense_fwd(x, w, impl, interpret):
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    x2 = x.reshape(-1, K)
+    xq, xs = quantize_int8(x2, axis=-1)
+    wq, ws = quantize_int8(w, axis=0)
+    if impl == "pallas":
+        out = int8_matmul_pallas(xq, xs, wq, ws, out_dtype=x.dtype,
+                                 interpret=interpret)
+    else:
+        out = int8_matmul(xq, xs, wq, ws, out_dtype=x.dtype)
+    return out.reshape(*lead, w.shape[1]), (x, w)
+
+
+def _qdense_bwd(impl, interpret, res, g):
+    x, w = res
+    gx = jnp.einsum("...n,kn->...k", g, w)
+    gw = jnp.einsum("...k,...n->kn", x, g)
+    return gx, gw
+
+
+quantized_dense.defvjp(_qdense_fwd, _qdense_bwd)
+
+
+# ----------------------------------------------------- quantized gather
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def quantized_all_gather(x, axis_name: str, axis: int = 0):
+    """All-gather a shard in int8 + per-row scales, dequantize after the
+    wire: the twin of torchao's fp8 all-gather under FSDP2
+    (``fp8_benchmark.py:79-81``; EQuARX explores the same trade for XLA).
+    Backward is a full-precision psum_scatter (mean-free sum), matching
+    the plain all_gather transpose."""
+    out, _ = _qag_fwd(x, axis_name, axis)
+    return out
+
+
+def _qag_fwd(x, axis_name, axis):
+    if x.ndim == 1:
+        # 1-D leaf (e.g. a norm scale): one scalar scale per shard,
+        # re-applied segment-wise after the gather.
+        ws = lax.axis_size(axis_name)
+        n = x.shape[0]
+        q, s = quantize_int8(x.reshape(1, n), axis=-1)  # s: (1, 1)
+        qg = C.all_gather(q.reshape(n), axis_name, axis=0)       # (ws*n,)
+        sg = C.all_gather(s.reshape(1), axis_name, axis=0)       # (ws,)
+        out = (qg.reshape(ws, n).astype(jnp.float32)
+               * sg[:, None]).reshape(-1).astype(x.dtype)
+        return out, None
+    # quantize along some dim that is NOT the gather dim, so the gathered
+    # scales stay broadcast-compatible with the gathered int8 data (each
+    # shard's scales travel with it over the wire).
+    qaxis = -1 if axis != x.ndim - 1 and axis != -1 else 0
+    q, s = quantize_int8(x, axis=qaxis)
+    qg = C.all_gather(q, axis_name, axis=axis)
+    sg = C.all_gather(s, axis_name, axis=axis)
+    return dequantize(qg, sg, x.dtype), None
+
+
+def _qag_bwd(axis_name, axis, res, g):
+    # the gathered output has x's dtype, so g.dtype == x.dtype
+    return (C.reduce_scatter(g.astype(jnp.float32), axis_name,
+                             axis=axis).astype(g.dtype),)
+
+
+quantized_all_gather.defvjp(_qag_fwd, _qag_bwd)

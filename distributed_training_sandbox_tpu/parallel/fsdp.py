@@ -106,11 +106,16 @@ def init_fsdp_opt_state(params_sharded, state_dtype=None):
 
 # ---------------------------------------------------------------- explicit
 
-def _gather_leaf(x, spec: P, axis: str):
+def _gather_leaf(x, spec: P, axis: str, quantized: bool = False):
     """all_gather a shard back to full size along its sharded dim (no-op for
-    leaves this axis doesn't shard)."""
+    leaves this axis doesn't shard).  ``quantized``: ship int8 + scales
+    over the wire and dequantize after (the torchao fp8-all-gather twin,
+    reference ``fp8/fp8_benchmark.py:79-81``)."""
     for dim, name in enumerate(spec):
         if name == axis:
+            if quantized:
+                from ..ops.quant import quantized_all_gather
+                return quantized_all_gather(x, axis, dim)
             return C.all_gather(x, axis, axis=dim)
     return x
 
@@ -122,6 +127,7 @@ def make_fsdp_train_step(
     axis: str = "dp",
     *,
     reshard_after_forward: bool = True,
+    quantized_gather: bool = False,
     lr: float = 3e-4,
     b1: float = 0.9,
     b2: float = 0.95,
@@ -149,15 +155,16 @@ def make_fsdp_train_step(
 
     def layer_hook(layer):
         with scope("fsdp_layer_gather"):
-            return _spec_map(lambda x, s: _gather_leaf(x, s, axis),
-                             layer, hook_specs)
+            return _spec_map(
+                lambda x, s: _gather_leaf(x, s, axis, quantized_gather),
+                layer, hook_specs)
 
     def step(shards, opt_state, batch):
         def sharded_loss(shards, batch):
             # Root group: embed / final_norm / lm_head gathered up front
             # (the root fully_shard wrap, reference train_fsdp.py:94).
             with scope("fsdp_root_gather"):
-                outer = {k: _gather_leaf(v, specs[k], axis)
+                outer = {k: _gather_leaf(v, specs[k], axis, quantized_gather)
                          for k, v in shards.items() if k != "layers"}
             if reshard_after_forward:
                 params = {**outer, "layers": shards["layers"]}
@@ -167,7 +174,7 @@ def make_fsdp_train_step(
             # 1849 tok/s knob, train_fsdp.py:85-86).
             with scope("fsdp_pre_gather_layers"):
                 full_layers = _spec_map(
-                    lambda x, s: _gather_leaf(x, s, axis),
+                    lambda x, s: _gather_leaf(x, s, axis, quantized_gather),
                     shards["layers"], layer_specs)
             params = {**outer, "layers": full_layers}
             return base_loss(params, batch, cfg, layer_hook=None)

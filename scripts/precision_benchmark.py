@@ -1,0 +1,149 @@
+"""Low-precision throughput benchmark — runnable twin of reference
+``fp8/fp8_benchmark.py``: train the real LM fully-sharded at a chosen
+precision and sequence length, track steps/s, tokens/s, TFLOPS and peak
+memory, write a per-run ``.txt`` log plus a ``summary_*.json`` keyed by
+model/precision/seq/devices (``fp8_benchmark.py:151-188``).
+
+v5e has no fp8 units, so the low-precision twin is int8 with dynamic
+absmax scaling (``--precision int8``; ``int8_pallas`` routes the matmuls
+through the hand-tiled Pallas kernel).  ``--sweep`` reproduces the
+seq×precision grid of ``fp8/modal_app.py:90-110``.
+
+Usage:
+  python scripts/precision_benchmark.py --model smollm3-350m \
+      --precision int8 --sequence-length 4096 [--num-steps 20]
+  python scripts/precision_benchmark.py --sweep [--model smollm3-350m]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_training_sandbox_tpu.models import MODEL_REGISTRY as MODELS  # noqa: E402
+
+SWEEP_SEQS = (2048, 4096, 8192)           # fp8/modal_app.py:90
+SWEEP_PRECISIONS = ("bf16", "int8")
+
+
+def run_one(model: str, precision: str, seq_len: int, num_steps: int,
+            batch_size: int | None, out_dir: Path) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from distributed_training_sandbox_tpu.utils import (
+        set_seed, make_mesh, PerformanceTracker, print_memory_stats)
+    from distributed_training_sandbox_tpu.utils.flops import (
+        get_model_flops_per_token)
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.parallel import fsdp
+    from distributed_training_sandbox_tpu.data import make_packed_dataset
+
+    mcfg: T.TransformerConfig = getattr(T, MODELS[model])
+    precision_fields = {"bf16": "bf16", "int8": "int8",
+                        "int8_pallas": "int8_pallas"}
+    mcfg = dataclasses.replace(
+        mcfg, matmul_precision=precision_fields[precision],
+        attention_impl="flash" if jax.default_backend() == "tpu" else "xla")
+    mesh = make_mesh()
+    ws = int(mesh.devices.size)
+    bs = batch_size or ws
+    key = set_seed(42)
+    params = T.init_params(key, mcfg)
+    shards = fsdp.shard_params_fsdp(params, mesh)
+    del params
+    opt = fsdp.init_fsdp_opt_state(shards)
+    step = fsdp.make_fsdp_train_step(shards, mcfg, mesh)
+
+    ii, ll = make_packed_dataset(seq_len, mcfg.vocab_size,
+                                 num_tokens=max(bs * 4, 8) * (seq_len + 1))
+    batch = (jnp.asarray(ii[:bs]), jnp.asarray(ll[:bs]))
+
+    flops_tok = get_model_flops_per_token(mcfg, seq_len)
+    tracker = PerformanceTracker(warmup_steps=min(3, num_steps - 1),
+                                 flops_per_token=flops_tok)
+    log_lines = []
+    metrics = None
+    for i in range(num_steps):
+        shards, opt, loss = step(shards, opt, batch)
+        jax.block_until_ready(loss)
+        metrics = tracker.step(bs * seq_len, loss=float(loss))
+        line = (f"step {i} loss {float(loss):.4f}")
+        log_lines.append(line)
+    mem = print_memory_stats(f"{model}-{precision}-{seq_len}",
+                             params=shards, opt_state=opt,
+                             printer=log_lines.append)
+
+    result = {
+        "model": model,
+        "precision": precision,
+        "sequence_length": seq_len,
+        "num_devices": ws,
+        "batch_size": bs,
+        "steps_per_second": metrics["steps_per_second"],
+        "tokens_per_second": metrics["tokens_per_second"],
+        "tflops_per_device": metrics.get("tflops_per_device", 0.0),
+        "avg_loss": metrics.get("avg_loss"),
+        "peak_memory": {
+            "device_peak_mb": mem["device_peak_mb"],
+            "model_mb": mem["model_mb"],
+            "optimizer_mb": mem["optimizer_mb"],
+        },
+    }
+    out_dir.mkdir(parents=True, exist_ok=True)
+    tag = f"{model}_{precision}_seq{seq_len}_dev{ws}"
+    (out_dir / f"{tag}.txt").write_text("\n".join(log_lines) + "\n")
+    print(f"[precision] {tag}: {result['tokens_per_second']:.0f} tok/s "
+          f"{result['tflops_per_device']:.2f} TFLOPS/dev")
+    return result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--model", choices=sorted(MODELS), default="tiny")
+    p.add_argument("--precision",
+                   choices=["bf16", "int8", "int8_pallas"], default="bf16")
+    p.add_argument("--sequence-length", type=int, default=None)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--num-steps", type=int, default=12)
+    p.add_argument("--sweep", action="store_true",
+                   help="seq x precision grid (fp8/modal_app.py:90-110)")
+    p.add_argument("--out-dir", type=str, default="./precision_results")
+    args = p.parse_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    out_dir = Path(args.out_dir)
+    if args.sweep:
+        grid = [(s, pr) for s in SWEEP_SEQS for pr in SWEEP_PRECISIONS]
+    else:
+        default_seq = 256 if args.model == "tiny" else 4096
+        grid = [(args.sequence_length or default_seq, args.precision)]
+
+    results = []
+    for seq, precision in grid:
+        try:
+            results.append(run_one(args.model, precision, seq,
+                                   args.num_steps, args.batch_size, out_dir))
+        except Exception as e:
+            print(f"[precision] {args.model}/{precision}/seq{seq} FAILED: "
+                  f"{type(e).__name__}: {str(e)[:160]}")
+
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    summary = out_dir / f"summary_{args.model}_{stamp}.json"
+    out_dir.mkdir(parents=True, exist_ok=True)
+    summary.write_text(json.dumps(results, indent=2))
+    print(f"[precision] summary -> {summary}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

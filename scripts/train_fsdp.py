@@ -22,8 +22,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-MODELS = {"smollm3-3b": "SMOLLM3_3B", "smollm3-3b-l8": "SMOLLM3_3B_L8",
-          "smollm3-350m": "SMOLLM3_350M", "tiny": "TINY_LM"}
+from distributed_training_sandbox_tpu.models import MODEL_REGISTRY as MODELS  # noqa: E402
 
 
 def main(argv=None):
