@@ -3,8 +3,13 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Metric: achieved model TFLOPS per device for the FSDP train step (AdamW,
-seq 8192, bf16, fused attention, streamed-vocab loss), computed with the
-same analytic FLOPs model the reference uses (``fsdp/utils.py:94-115``).
+seq 8192, bf16, fused attention, streamed-vocab loss), computed with this
+repo's analytic FLOPs model (``utils/flops.py``).  NOTE: that model is NOT
+term-identical to the reference's (``fsdp/utils.py:94-115``): it applies a
+0.5 causal discount to the seq-quadratic attention term and includes the
+vocab head, which the reference omits.  The reference's tok/s baseline is
+converted to TFLOPS with the SAME formula, so ``vs_baseline`` compares
+apples to apples; the absolute TFLOPS just follow this repo's convention.
 
 Baseline: the reference's best published FSDP number — SmolLM3-3B at
 seq 8192 on 2×A100-80GB, 3,000 tok/s with ``reshard_after_forward=False``

@@ -1,3 +1,3 @@
 from .packing import (  # noqa: F401
     pack_tokens, packed_batches, synthetic_token_stream,
-    get_tinystories_tokens, make_packed_dataset)
+    get_tinystories_tokens, make_packed_dataset, VocabMismatchError)

@@ -23,6 +23,12 @@ from __future__ import annotations
 import numpy as np
 
 
+class VocabMismatchError(ValueError):
+    """Token ids exceed the model vocab — a configuration error that must
+    never be silently papered over by the synthetic fallback (JAX clamps
+    OOB gather indices instead of raising)."""
+
+
 def pack_tokens(tokens: np.ndarray, seq_len: int) -> tuple[np.ndarray, np.ndarray]:
     """Concatenated token stream → (input_ids, labels), each
     (n_windows, seq_len).  Window stride is seq_len + 1 and the ragged tail
@@ -110,16 +116,20 @@ def make_packed_dataset(seq_len: int, vocab_size: int, *,
                 raise OSError("hub unreachable")
             stream = get_tinystories_tokens(split_percent=split_percent)
             if stream.max() >= vocab_size:
-                # JAX clamps OOB gather indices silently — never feed a
-                # tokenizer's ids to a smaller model vocab.
-                raise ValueError(
+                # A configuration error, not an availability problem, so it
+                # escapes the auto fallback below.
+                raise VocabMismatchError(
                     f"TinyStories token ids go up to {stream.max()}, model "
                     f"vocab is {vocab_size}; use a matching tokenizer or "
                     f"source='synthetic'")
             return pack_tokens(stream, seq_len)
-        except Exception:
+        except VocabMismatchError:
+            raise
+        except Exception as e:
             if source == "tinystories":
                 raise
+            print(f"[data] TinyStories unavailable ({type(e).__name__}: {e});"
+                  f" falling back to synthetic Zipfian tokens", flush=True)
     if num_tokens is None:
         num_tokens = 64 * (seq_len + 1)
     stream = synthetic_token_stream(num_tokens, vocab_size, seed)

@@ -110,10 +110,13 @@ def _gather_leaf(x, spec: P, axis: str, quantized: bool = False):
     """all_gather a shard back to full size along its sharded dim (no-op for
     leaves this axis doesn't shard).  ``quantized``: ship int8 + scales
     over the wire and dequantize after (the torchao fp8-all-gather twin,
-    reference ``fp8/fp8_benchmark.py:79-81``)."""
+    reference ``fp8/fp8_benchmark.py:79-81``).  Like torchao — which only
+    low-precision-casts Linear weights — 1-D leaves (RMSNorm scales) stay
+    in full precision: quantizing them saves negligible bandwidth and costs
+    outsized numerics."""
     for dim, name in enumerate(spec):
         if name == axis:
-            if quantized:
+            if quantized and x.ndim > 1:
                 from ..ops.quant import quantized_all_gather
                 return quantized_all_gather(x, axis, dim)
             return C.all_gather(x, axis, axis=dim)

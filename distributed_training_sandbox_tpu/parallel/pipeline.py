@@ -203,11 +203,26 @@ def run_gpipe(stages: list[PipelineStage], x, y, n_micro: int = 4,
 
 
 def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
-             lr: float = 1e-3) -> float:
-    """One 1F1B step: clock scheduler, ``ticks = n_micro + n_stages - 1``
-    (``1f1b.py:102``).  Each tick, each stage does at most one forward and
-    one backward; activations are freed as backwards consume them, so peak
-    stored microbatch inputs per stage ~n_stages (``1f1b.py:4-11``)."""
+             lr: float = 1e-3, schedule_trace: list | None = None) -> float:
+    """One 1F1B step: clock scheduler, exactly ``ticks = n_micro + n_stages
+    - 1`` iterations (``1f1b.py:102-107``), no early exit.  Each tick, each
+    stage (ascending order) does at most one forward and one backward.
+
+    Tick-level semantics pinned to the reference (``1f1b.py:107-158``):
+    stages iterate in ascending order and queues are NOT snapshotted at
+    tick start, so a forward output enqueued for stage s+1 is consumed in
+    the SAME tick — a microbatch traverses the whole forward pipeline in
+    one tick, while backward gradients (relayed to a lower, already-visited
+    stage) advance one stage per tick.  That skew is why exactly
+    ``n_micro + n_stages - 1`` ticks drain the pipeline: stage 0 launches
+    mb k at tick k, mb k's backward reaches stage 0 at tick
+    k + n_stages - 1.  Activations are freed as backwards consume them, so
+    peak stored microbatch inputs per stage ~n_stages (``1f1b.py:4-11``).
+
+    ``schedule_trace``: optional list collecting ``(tick, stage, op, mb)``
+    events for tick-parity tests — the in-memory form of what the
+    reference's profiler trace would show.
+    """
     n_stages = len(stages)
     xs, ys = _microbatch(x, y, n_micro)
     inv = jnp.float32(1.0 / n_micro)
@@ -220,8 +235,7 @@ def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
 
     mb_losses = []
     ticks = n_micro + n_stages - 1
-    for _tick in range(ticks * 2):  # *2: fwd and bwd sub-slots interleave
-        progressed = False
+    for tick in range(ticks):
         for s, stage in enumerate(stages):
             # one forward per tick per stage (1f1b.py:112-131)
             if fwd_q[s]:
@@ -234,7 +248,8 @@ def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
                     bwd_q[s].append((mb, None))
                 else:
                     fwd_q[s + 1].append((mb, stage.fwd(stage.params, xin)))
-                progressed = True
+                if schedule_trace is not None:
+                    schedule_trace.append((tick, s, "fwd", mb))
             # one backward per tick per stage (1f1b.py:134-158)
             if bwd_q[s]:
                 mb, gout = bwd_q[s].popleft()
@@ -249,9 +264,12 @@ def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
                 stage.accumulate(gp)
                 if s > 0:
                     bwd_q[s - 1].append((mb, gx))
-                progressed = True
-        if not progressed and all(not q for q in fwd_q + bwd_q):
-            break
+                if schedule_trace is not None:
+                    schedule_trace.append((tick, s, "bwd", mb))
+
+    leftover = sum(len(q) for q in fwd_q + bwd_q)
+    assert leftover == 0, (
+        f"1F1B clock did not drain in {ticks} ticks: {leftover} queued items")
 
     for stage in stages:
         stage.step(lr)

@@ -56,8 +56,12 @@ def build_argparser(parser: argparse.ArgumentParser | None = None):
     p.add_argument("--batch-size", dest="batch_size", type=int, default=None)
     p.add_argument("--sequence-length", dest="sequence_length", type=int,
                    default=None)
+    # No "fp8" choice: v5e has no fp8 units and the reference's own
+    # `--precision fp8` flag in fsdp/ is declared-but-ignored (its quirk #9,
+    # SURVEY.md §2.9) — int8 is the implemented low-precision path here.
     p.add_argument("--precision", dest="precision",
-                   choices=["bf16", "fp32", "int8", "fp8"], default=None)
+                   choices=["bf16", "fp32", "int8", "int8_pallas"],
+                   default=None)
     p.add_argument("--seed", dest="seed", type=int, default=None)
     p.add_argument("--run-name", dest="run_name", type=str, default=None)
     p.add_argument("--trace-dir", dest="trace_dir", type=str, default=None)

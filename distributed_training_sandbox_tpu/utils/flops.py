@@ -1,8 +1,20 @@
-"""Analytic transformer FLOPs model, twin of ``get_model_flops_per_token``
+"""Analytic transformer FLOPs model in the role of ``get_model_flops_per_token``
 (reference ``fsdp/utils.py:94-115``): per-token forward+backward FLOPs from the
-architecture — attention projections, the sequence-quadratic dot-product term,
-the (gated) MLP, and the vocab head.  Feeds the TFLOPS / MFU metric in
-PerformanceTracker exactly as in the reference.
+architecture, feeding the TFLOPS / MFU metric in PerformanceTracker.
+
+Convention note — this model deliberately does NOT match the reference's
+formula term-for-term.  Differences:
+
+  * the sequence-quadratic attention term carries a 0.5 causal discount
+    (only half the positions are attended on average); the reference counts
+    the full square;
+  * the vocab head (``2·h·vocab`` per token) is included; the reference
+    ignores it (at 128k vocab it is ~9% of a 3B model's per-token FLOPs).
+
+Both conventions are self-consistent for A/B ratios; absolute TFLOPS printed
+by this repo are computed under THIS convention, including when converting
+the reference's published tok/s baselines for the ``vs_baseline`` ratio (see
+``bench.py``), so the ratio remains apples-to-apples.
 """
 
 from __future__ import annotations

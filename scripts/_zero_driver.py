@@ -14,6 +14,8 @@ import sys
 import time
 from pathlib import Path
 
+# Prepend the checkout root so the source tree always wins over any
+# installed copy of the package (`pip install -e .` makes this a no-op).
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 

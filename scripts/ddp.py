@@ -15,9 +15,12 @@ Usage:
 from __future__ import annotations
 
 import argparse
+
 import sys
 from pathlib import Path
 
+# Prepend the checkout root so the source tree always wins over any
+# installed copy of the package (`pip install -e .` makes this a no-op).
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 

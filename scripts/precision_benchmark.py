@@ -24,6 +24,8 @@ import sys
 import time
 from pathlib import Path
 
+# Prepend the checkout root so the source tree always wins over any
+# installed copy of the package (`pip install -e .` makes this a no-op).
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from distributed_training_sandbox_tpu.models import MODEL_REGISTRY as MODELS  # noqa: E402
@@ -66,7 +68,7 @@ def run_one(model: str, precision: str, seq_len: int, num_steps: int,
 
     flops_tok = get_model_flops_per_token(mcfg, seq_len)
     tracker = PerformanceTracker(warmup_steps=min(3, num_steps - 1),
-                                 flops_per_token=flops_tok)
+                                 flops_per_token=flops_tok, num_devices=ws)
     log_lines = []
     metrics = None
     for i in range(num_steps):

@@ -20,6 +20,8 @@ import dataclasses
 import sys
 from pathlib import Path
 
+# Prepend the checkout root so the source tree always wins over any
+# installed copy of the package (`pip install -e .` makes this a no-op).
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from distributed_training_sandbox_tpu.models import MODEL_REGISTRY as MODELS  # noqa: E402
@@ -64,6 +66,12 @@ def main(argv=None):
         mcfg = dataclasses.replace(mcfg, attention_impl=args.attention)
     if args.remat_policy:
         mcfg = dataclasses.replace(mcfg, remat_policy=args.remat_policy)
+    # Consume the shared --precision knob (the reference's fsdp dir declares
+    # `--precision fp8` and ignores it — its quirk #9; this one is real).
+    if cfg.precision in ("int8", "int8_pallas"):
+        mcfg = dataclasses.replace(mcfg, matmul_precision=cfg.precision)
+    elif cfg.precision == "fp32":
+        mcfg = dataclasses.replace(mcfg, dtype=jnp.float32)
     mesh = make_mesh()
     ws = get("ws")
     # global batch = 1 per device by default (reference's bs=1 dataloader,
@@ -100,7 +108,7 @@ def main(argv=None):
     flops_tok = get_model_flops_per_token(mcfg, cfg.sequence_length)
     tracker = PerformanceTracker(
         warmup_steps=min(5, max(cfg.num_steps - 1, 0)),
-        flops_per_token=flops_tok)
+        flops_per_token=flops_tok, num_devices=ws)
     prof = Profiler(trace_dir=cfg.trace_dir,
                     schedule=ProfileSchedule(skip_first=0, wait=5, warmup=5,
                                              active=10)) if cfg.profile else None

@@ -90,6 +90,27 @@ def test_activation_highwater(setup):
     assert f_stages[1].max_stored <= 2
 
 
+def test_1f1b_tick_schedule_parity(setup):
+    """Tick-level twin of the reference clock (1f1b.py:102-158): exactly
+    n_micro + n_stages - 1 ticks; ascending stage order without queue
+    snapshots, so forwards traverse the whole pipeline within one tick
+    (the last stage's own backward fires the same tick) while relayed
+    backward grads advance one stage per tick."""
+    params, x, y = setup
+    stages = build_pipeline(params, n_stages=2)
+    trace = []
+    run_1f1b(stages, x, y, n_micro=N_MICRO, schedule_trace=trace)
+    expected = [
+        (0, 0, "fwd", 0), (0, 1, "fwd", 0), (0, 1, "bwd", 0),
+        (1, 0, "fwd", 1), (1, 0, "bwd", 0), (1, 1, "fwd", 1), (1, 1, "bwd", 1),
+        (2, 0, "fwd", 2), (2, 0, "bwd", 1), (2, 1, "fwd", 2), (2, 1, "bwd", 2),
+        (3, 0, "fwd", 3), (3, 0, "bwd", 2), (3, 1, "fwd", 3), (3, 1, "bwd", 3),
+        (4, 0, "bwd", 3),
+    ]
+    assert trace == expected
+    assert max(t for t, *_ in trace) == N_MICRO + 2 - 1 - 1  # last tick index
+
+
 def test_four_stages(setup):
     params, x, y = setup
     stages = build_pipeline(params, n_stages=4)
