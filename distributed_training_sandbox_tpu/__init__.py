@@ -15,6 +15,9 @@ Layer map (SURVEY.md §1):
   L4 launch        -> launch.launcher (config-driven, run-id'd trace dirs)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from . import utils, ops  # noqa: F401
+# `launch` is importable as a subpackage (`from distributed_training_sandbox_tpu
+# import launch`) but not imported eagerly: it is pure stdlib and must stay
+# importable before jax backend initialization.

@@ -1,0 +1,9 @@
+"""L4 launch/deploy layer (SURVEY.md §1, §2.1): config-driven strategy
+launcher with run-id'd trace directories and a run→sync→view loop — the
+TPU-native twin of ``modal_utils.py`` + ``DDP/scripts/profile.sh`` +
+``DDP/training_utils/trun.py``."""
+
+from . import launcher  # noqa: F401
+from .launcher import (  # noqa: F401
+    LaunchConfig, RunResult, STRATEGY_SCRIPTS, build_launch_command,
+    parse_device_spec, run_training, sync_traces, view_command)

@@ -1,0 +1,275 @@
+"""L4 launch layer: config-driven strategy launcher with run-id'd trace dirs.
+
+TPU-native twin of the reference's Modal launcher library
+(``modal_utils.py:21-246``) and of its local ``trun`` wrapper
+(``DDP/training_utils/trun.py:16-25``).  The responsibilities transfer; the
+substrate changes:
+
+  * the reference builds a cloud container and execs ``torchrun
+    --nproc_per_node=N`` inside it; here the "cluster" is a jax device mesh —
+    real TPU chips, or ``--cpu-devices N`` simulated devices (the gloo-mode
+    twin) — so launching is spawning ONE Python process per host, not N.
+  * the GPU spec string ``"A10G:2"`` (``modal_utils.get_gpu_count``,
+    ``modal_utils.py:60-72``) becomes a device spec ``"tpu"`` / ``"tpu:4"`` /
+    ``"cpu:8"``: platform[:count].
+  * the trace Volume + ``modal volume get`` retrieval loop
+    (``DDP/scripts/profile.sh:97-109``) becomes a local run-id'd trace
+    directory (``TRACE_DIR/<run_id>``) plus `sync_traces` (copy to a
+    destination, e.g. a mounted bucket or rsync staging dir) and a printed
+    TensorBoard recipe (``modal_utils._print_completion_message`` twin).
+
+Config schema (dict or JSON/YAML file — inline dicts are what the reference
+uses in every per-dir ``modal_app.py``, e.g. ``zero/modal_app.py:9-17``):
+
+    {"app":      {"name": "zero", "script_dir": "scripts"},
+     "devices":  {"spec": "cpu:8", "timeout": 1800},
+     "trace":    {"root": "./profiler_traces", "local_dir": "./traces"},
+     "launcher": {"env": {...}, "args": [...]}}
+
+Every key has a default; ``LaunchConfig()`` with no args launches on
+whatever devices exist.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..utils.config import build_run_id
+
+#: strategy name -> script filename under script_dir (the `--script zero2.py`
+#: surface of `RUN_MODAL.md:7-28`; bare names and `.py` names both accepted).
+STRATEGY_SCRIPTS = {
+    "ddp": "ddp.py",
+    "zero1": "zero1.py",
+    "zero2": "zero2.py",
+    "zero3": "zero3.py",
+    "fsdp": "train_fsdp.py",
+    "train_fsdp": "train_fsdp.py",
+    "gpipe": "gpipe.py",
+    "1f1b": "1f1b.py",
+    "precision": "precision_benchmark.py",
+    "precision_benchmark": "precision_benchmark.py",
+    "busbench": "busbench.py",
+}
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def parse_device_spec(spec: str) -> tuple[str, int | None]:
+    """``"cpu:8"`` -> ("cpu", 8); ``"tpu"`` -> ("tpu", None) = all chips.
+    Twin of ``modal_utils.get_gpu_count`` (``modal_utils.py:60-72``)."""
+    if ":" not in spec:
+        return spec, None
+    platform, count_str = spec.split(":", 1)
+    try:
+        count = int(count_str)
+    except ValueError as exc:
+        raise ValueError(f"Invalid device spec {spec!r}. Expected "
+                         f"'PLATFORM:COUNT'.") from exc
+    if count < 1:
+        raise ValueError("device count must be >= 1")
+    return platform, count
+
+
+@dataclass
+class LaunchConfig:
+    """Launcher-level knobs, twin of ``ModalConfig`` (``modal_utils.py:21-59``)
+    minus the container-image concerns a TPU-VM doesn't have."""
+    name: str = "dts"
+    script_dir: str | os.PathLike = _REPO_ROOT / "scripts"
+    script: str = "fsdp"
+    device_spec: str = "tpu"
+    timeout: float | None = 1800.0          # zero/modal_app.py:12
+    trace_root: str | os.PathLike = "./profiler_traces"
+    trace_output_dir: str | os.PathLike = "./traces"   # sync destination
+    env: dict = field(default_factory=dict)
+    extra_args: list = field(default_factory=list)
+
+    @classmethod
+    def from_config(cls, config: dict | str | os.PathLike) -> "LaunchConfig":
+        """Dict, or path to a JSON/YAML file with the schema in the module
+        docstring."""
+        if not isinstance(config, dict):
+            text = Path(config).read_text()
+            if str(config).endswith((".yaml", ".yml")):
+                import yaml  # gated: baked into the image with jax
+                config = yaml.safe_load(text)
+            else:
+                config = json.loads(text)
+        app = config.get("app", {})
+        devices = config.get("devices", {})
+        trace = config.get("trace", {})
+        launcher = config.get("launcher", {})
+        kw = {}
+        if "name" in app:
+            kw["name"] = app["name"]
+        if "script_dir" in app:
+            kw["script_dir"] = app["script_dir"]
+        if "training_script" in app:
+            kw["script"] = app["training_script"]
+        if "spec" in devices:
+            kw["device_spec"] = devices["spec"]
+        if "timeout" in devices:
+            kw["timeout"] = devices["timeout"]
+        if "root" in trace:
+            kw["trace_root"] = trace["root"]
+        if "local_dir" in trace:
+            kw["trace_output_dir"] = trace["local_dir"]
+        kw["env"] = dict(launcher.get("env", {}))
+        kw["extra_args"] = list(launcher.get("args", []))
+        return cls(**kw)
+
+    def resolve_script(self, script: str | None = None) -> Path:
+        """Strategy name or filename -> script path, validated the way each
+        ``modal_app.py`` local entrypoint validates ``--script``
+        (``zero/modal_app.py:21-31``).
+
+        The default script_dir is the source checkout's ``scripts/``; a
+        wheel install doesn't ship it, so fall back to ``./scripts`` (run
+        from a checkout) before erroring with a pointer to the config."""
+        name = script or self.script
+        fname = STRATEGY_SCRIPTS.get(name.removesuffix(".py"),
+                                     name if name.endswith(".py")
+                                     else name + ".py")
+        for base in (Path(self.script_dir), Path.cwd() / "scripts"):
+            path = base / fname
+            if path.exists():
+                return path
+        known = ", ".join(sorted(set(STRATEGY_SCRIPTS)))
+        raise FileNotFoundError(
+            f"training script {fname} not found under {self.script_dir} or "
+            f"./scripts — run from a source checkout or point "
+            f"app.script_dir at the strategy scripts. Known strategies: "
+            f"{known}")
+
+
+@dataclass
+class RunResult:
+    run_id: str
+    trace_dir: Path
+    command: list[str]
+    returncode: int
+
+
+def build_launch_command(config: LaunchConfig, script: str | None = None,
+                         extra_args: list | None = None) -> list[str]:
+    """Twin of ``modal_utils.build_launch_command`` (``modal_utils.py:107-148``).
+    The torchrun/accelerate/python trichotomy collapses: SPMD JAX wants ONE
+    process per host, so the launcher is always ``sys.executable``; the
+    device spec rides on ``--cpu-devices`` (simulated mesh) or the default
+    TPU runtime (real chips)."""
+    platform, count = parse_device_spec(config.device_spec)
+    cmd = [sys.executable, str(config.resolve_script(script))]
+    if platform == "cpu":
+        cmd += ["--cpu-devices", str(count or 8)]
+    elif platform in ("tpu", "auto"):
+        if count is not None:
+            # Chip subsetting needs runtime support the scripts don't have
+            # (they build their mesh over every visible device); refuse
+            # loudly rather than run on all chips while claiming `count`.
+            raise ValueError(
+                f"device spec {config.device_spec!r}: TPU chip subsetting "
+                f"is not supported — use 'tpu' (all chips) or 'cpu:<n>'")
+    else:
+        raise ValueError(f"unsupported platform {platform!r} "
+                         f"(expected tpu, cpu:<n>, or auto)")
+    cmd += [str(a) for a in config.extra_args]
+    if extra_args:
+        cmd += [str(a) for a in extra_args]
+    return cmd
+
+
+def run_training(config: LaunchConfig, *, script: str | None = None,
+                 run_name: str | None = None, num_steps: int | None = None,
+                 num_epochs: int | None = None, extra_args: list | None = None,
+                 dry_run: bool = False) -> RunResult:
+    """Launch one strategy script with a run-id'd trace dir — the
+    ``run_training`` + ``train()`` arg-mapping twin
+    (``modal_utils.py:151-188`` and ``:211-241``).
+
+    Env contract: ``TRACE_DIR=<trace_root>/<run_id>`` is exported to the
+    child (the scripts' ``default_trace_dir`` reads it), so traces land in
+    a per-run directory the way each Modal run lands in its own volume
+    prefix (``DDP/modal_app.py:116-121``)."""
+    combined = []
+    if num_steps is not None:
+        combined += ["--num-steps", str(num_steps)]
+    if num_epochs is not None:
+        combined += ["--num-epochs", str(num_epochs)]
+    if extra_args:
+        combined += list(extra_args)
+
+    run_id = build_run_id(run_name)
+    trace_dir = Path(config.trace_root) / run_id
+    cmd = build_launch_command(config, script, combined)
+
+    env = os.environ.copy()
+    env["TRACE_DIR"] = str(trace_dir)
+    env.update({k: str(v) for k, v in config.env.items()})
+
+    print(f"[launch] {config.name}: {' '.join(cmd)}")
+    print(f"[launch] TRACE_DIR={trace_dir}")
+    if dry_run:
+        return RunResult(run_id, trace_dir, cmd, 0)
+    trace_dir.mkdir(parents=True, exist_ok=True)
+    proc = subprocess.run(cmd, env=env, timeout=config.timeout)
+    if proc.returncode == 0:
+        print_completion_message(config, run_id, script or config.script)
+    else:
+        print(f"[launch] FAILED (exit {proc.returncode}): {' '.join(cmd)}",
+              file=sys.stderr)
+    return RunResult(run_id, trace_dir, cmd, proc.returncode)
+
+
+def sync_traces(config: LaunchConfig, run_id: str | None = None,
+                dest: str | os.PathLike | None = None) -> Path:
+    """Copy trace dirs to the retrieval destination — local twin of
+    ``modal volume get <vol> / <dest> --force`` (``profile.sh:97-102``).
+    ``run_id=None`` syncs every run under the trace root."""
+    dest = Path(dest or config.trace_output_dir)
+    root = Path(config.trace_root)
+    if run_id:
+        if not (root / run_id).is_dir():
+            raise FileNotFoundError(f"no run {run_id!r} under {root}")
+        src_dirs = [root / run_id]
+    else:
+        src_dirs = sorted(p for p in root.iterdir() if p.is_dir()) \
+            if root.exists() else []
+        if not src_dirs:
+            print(f"[launch] nothing to sync under {root}")
+    dest.mkdir(parents=True, exist_ok=True)
+    for src in src_dirs:
+        shutil.copytree(src, dest / src.name, dirs_exist_ok=True)
+        print(f"[launch] synced {src} -> {dest / src.name}")
+    return dest
+
+
+def print_completion_message(config: LaunchConfig, run_id: str,
+                             script: str) -> None:
+    """``modal_utils._print_completion_message`` twin (``:249-260``)."""
+    root = Path(config.trace_root)
+    print(f"\n[launch] Training complete!\n"
+          f"  Run ID: {run_id}\n"
+          f"  Script: {script}\n"
+          f"  Traces: {root / run_id}\n"
+          f"View with:\n"
+          f"  tensorboard --logdir {root / run_id}\n"
+          f"(or open the .trace.json.gz under plugins/profile/ at "
+          f"ui.perfetto.dev)")
+
+
+def view_command(config: LaunchConfig, run_id: str | None = None,
+                 port: int = 6006) -> list[str]:
+    """The `view` leg of profile.sh (``:104-109``): returns the TensorBoard
+    invocation (callers may exec it; the CLI prints it by default since the
+    build environment is headless)."""
+    logdir = Path(config.trace_root)
+    if run_id:
+        logdir = logdir / run_id
+    return ["tensorboard", "--logdir", str(logdir), "--port", str(port)]

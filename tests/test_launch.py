@@ -1,0 +1,160 @@
+"""L4 launcher layer: device-spec parsing, command construction, config
+loading, run-id'd trace dirs, sync loop (reference ``modal_utils.py``,
+``DDP/scripts/profile.sh`` twins).  Pure stdlib — no jax backend needed."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from distributed_training_sandbox_tpu.launch import (
+    LaunchConfig, STRATEGY_SCRIPTS, build_launch_command, parse_device_spec,
+    run_training, sync_traces, view_command)
+
+
+def test_parse_device_spec():
+    assert parse_device_spec("tpu") == ("tpu", None)
+    assert parse_device_spec("cpu:8") == ("cpu", 8)
+    assert parse_device_spec("tpu:4") == ("tpu", 4)
+    with pytest.raises(ValueError, match="Invalid device spec"):
+        parse_device_spec("cpu:lots")
+    with pytest.raises(ValueError, match=">= 1"):
+        parse_device_spec("cpu:0")
+
+
+def test_strategy_registry_scripts_exist():
+    """Every advertised strategy resolves to a real script (the
+    modal_app.py --script validation twin, zero/modal_app.py:21-31)."""
+    cfg = LaunchConfig()
+    for name in STRATEGY_SCRIPTS:
+        assert cfg.resolve_script(name).exists(), name
+
+
+def test_build_launch_command_cpu_mesh():
+    cfg = LaunchConfig(device_spec="cpu:8", script="zero2")
+    cmd = build_launch_command(cfg)
+    assert cmd[0] == sys.executable
+    assert cmd[1].endswith("zero2.py")
+    assert cmd[2:4] == ["--cpu-devices", "8"]
+
+
+def test_build_launch_command_tpu_and_extra_args():
+    cfg = LaunchConfig(device_spec="tpu", extra_args=["--scale", 40])
+    cmd = build_launch_command(cfg, "ddp", ["--num-steps", "3"])
+    assert "--cpu-devices" not in cmd
+    assert cmd[-4:] == ["--scale", "40", "--num-steps", "3"]
+
+
+def test_build_launch_command_rejects_unknown_platform():
+    with pytest.raises(ValueError, match="unsupported platform"):
+        build_launch_command(LaunchConfig(device_spec="gpu:2"), "ddp")
+
+
+def test_build_launch_command_rejects_tpu_subset():
+    """Scripts mesh over every visible chip; a tpu:N count would silently
+    lie about the device count, so it must refuse."""
+    with pytest.raises(ValueError, match="subsetting"):
+        build_launch_command(LaunchConfig(device_spec="tpu:4"), "ddp")
+
+
+def test_run_training_propagates_child_failure(tmp_path):
+    """A failing child exits through RunResult.returncode, not an
+    exception (scriptability of the CLI exit status)."""
+    cfg = LaunchConfig(device_spec="cpu:2", trace_root=tmp_path, timeout=120)
+    res = run_training(cfg, script="ddp",
+                       extra_args=["--num-steps", "notanint"])
+    assert res.returncode != 0
+
+
+def test_sync_unknown_run_id_raises(tmp_path):
+    cfg = LaunchConfig(trace_root=tmp_path)
+    with pytest.raises(FileNotFoundError, match="no run"):
+        sync_traces(cfg, "20990101-000000-nope")
+
+
+def test_resolve_script_unknown():
+    with pytest.raises(FileNotFoundError, match="nown strategies"):
+        LaunchConfig().resolve_script("nonexistent_strategy")
+
+
+def test_config_from_dict_and_json(tmp_path):
+    config = {"app": {"name": "zero-sweep", "training_script": "zero1"},
+              "devices": {"spec": "cpu:4", "timeout": 60},
+              "trace": {"root": str(tmp_path / "tr")},
+              "launcher": {"env": {"FOO": "1"}, "args": ["--scale", "40"]}}
+    for source in (config, None):
+        if source is None:
+            f = tmp_path / "cfg.json"
+            f.write_text(json.dumps(config))
+            source = f
+        cfg = LaunchConfig.from_config(source)
+        assert cfg.name == "zero-sweep"
+        assert cfg.script == "zero1"
+        assert cfg.device_spec == "cpu:4"
+        assert cfg.timeout == 60
+        assert cfg.env == {"FOO": "1"}
+        assert cfg.extra_args == ["--scale", "40"]
+
+
+def test_run_training_dry_run_sets_trace_dir(tmp_path):
+    """Run ids follow build_run_id (YYYYMMDD-HHMMSS[-label]) and the child
+    TRACE_DIR is <trace_root>/<run_id> (DDP/modal_app.py:116-121 twin)."""
+    cfg = LaunchConfig(device_spec="cpu:2", trace_root=tmp_path)
+    res = run_training(cfg, script="ddp", run_name="smoke",
+                       num_steps=1, dry_run=True)
+    assert res.run_id.endswith("-smoke")
+    assert res.trace_dir == Path(tmp_path) / res.run_id
+    assert res.command[1].endswith("ddp.py")
+    assert res.command[2:4] == ["--cpu-devices", "2"]
+    assert "--num-steps" in res.command
+
+
+def test_sync_and_view(tmp_path):
+    root = tmp_path / "traces"
+    (root / "20260101-000000-x" / "plugins").mkdir(parents=True)
+    (root / "20260101-000000-x" / "plugins" / "t.json").write_text("{}")
+    cfg = LaunchConfig(trace_root=root, trace_output_dir=tmp_path / "dest")
+    dest = sync_traces(cfg)
+    assert (dest / "20260101-000000-x" / "plugins" / "t.json").exists()
+    cmd = view_command(cfg, "20260101-000000-x", port=7007)
+    assert cmd[0] == "tensorboard" and "--port" in cmd
+
+
+def test_cli_dry_run_end_to_end(tmp_path):
+    """The one-command surface: `dts-launch run --script ddp ...` builds the
+    right command + trace dir without a jax backend in the parent."""
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_training_sandbox_tpu.launch.cli",
+         "run", "--script", "ddp", "--run-name", "clitest", "--num-steps",
+         "2", "--devices", "cpu:2", "--trace-root", str(tmp_path),
+         "--dry-run"],
+        capture_output=True, text=True, cwd=Path(__file__).parent.parent)
+    assert r.returncode == 0, r.stderr
+    assert "ddp.py" in r.stdout and "clitest" in r.stdout
+    assert "--cpu-devices 2" in r.stdout
+
+
+def test_cli_list(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_training_sandbox_tpu.launch.cli",
+         "list", "--trace-root", str(tmp_path)],
+        capture_output=True, text=True, cwd=Path(__file__).parent.parent)
+    assert r.returncode == 0, r.stderr
+    for name in ("ddp", "zero1", "fsdp", "busbench"):
+        assert name in r.stdout
+
+
+@pytest.mark.slow
+def test_launcher_real_run(tmp_path):
+    """Full run leg: launch the ddp strategy on a 2-device sim mesh through
+    the launcher; traces must land under the run-id dir (the run→sync loop
+    of profile.sh:167-199, locally)."""
+    cfg = LaunchConfig(device_spec="cpu:2", trace_root=tmp_path,
+                       timeout=600)
+    res = run_training(cfg, script="ddp", run_name="e2e", num_steps=8,
+                       extra_args=["--scale", "100"])
+    assert res.returncode == 0
+    traced = list(Path(res.trace_dir).rglob("*.json.gz"))
+    assert traced, f"no traces under {res.trace_dir}"
