@@ -1,3 +1,6 @@
 from .packing import (  # noqa: F401
     pack_tokens, packed_batches, synthetic_token_stream,
     get_tinystories_tokens, make_packed_dataset, VocabMismatchError)
+from .classification import (  # noqa: F401
+    classification_batches, make_classification_examples, pad_collate,
+    shard_examples, synthetic_pair_examples)

@@ -1,0 +1,151 @@
+"""Sentence-pair classification data — twin of the reference's GLUE MRPC
+pipeline (``DDP/training_utils/utils.py:90-107``) with its DDP collate
+(``DDP/ddp.py:64-71``: ``tokenizer.pad(padding="longest",
+pad_to_multiple_of=8)``) and per-rank contiguous dataset sharding
+(``DDP/ddp.py:104-112``).
+
+Examples are plain dicts ``{"input_ids": list[int], "labels": int}`` —
+the post-tokenization shape of the reference's mapped dataset.  The real
+MRPC path (HF datasets + tokenizer) is gated behind hub reachability; the
+offline fallback generates deterministic variable-length synthetic pairs
+whose *learnable rule* (label = whether the two halves share their most
+frequent token) gives training curves something real to descend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .packing import _hub_reachable
+
+
+def synthetic_pair_examples(n_examples: int, vocab_size: int,
+                            seed: int = 42, min_len: int = 16,
+                            max_len: int = 96) -> list[dict]:
+    """Deterministic MRPC-stand-in: two token spans [sep-joined]; label 1
+    iff span B reuses span A's dominant token.  Variable lengths exercise
+    the pad-to-multiple-of-8 collate the way real tokenized pairs do."""
+    rng = np.random.default_rng(seed)
+    sep = vocab_size - 1
+    out = []
+    for _ in range(n_examples):
+        la, lb = rng.integers(min_len // 2, max_len // 2, size=2)
+        a = rng.integers(1, vocab_size - 1, size=la)
+        b = rng.integers(1, vocab_size - 1, size=lb)
+        label = int(rng.random() < 0.5)
+        dominant = np.bincount(a).argmax()
+        if label:
+            b[rng.integers(0, lb, size=max(lb // 4, 1))] = dominant
+        else:
+            b = b[b != dominant]
+            if len(b) == 0:
+                b = np.array([1 + (dominant + 1) % (vocab_size - 2)])
+        ids = np.concatenate([a, [sep], b]).astype(np.int32)
+        out.append({"input_ids": ids.tolist(), "labels": label})
+    return out
+
+
+def get_mrpc_examples(tokenizer_name: str = "HuggingFaceTB/SmolLM2-360M-Instruct",
+                      split: str = "train") -> list[dict]:
+    """The real GLUE MRPC path (requires network): tokenize sentence pairs,
+    keep input_ids + labels — reference ``get_dataset``
+    (``DDP/training_utils/utils.py:90-107``)."""
+    from datasets import load_dataset  # gated import
+    from transformers import AutoTokenizer
+
+    ds = load_dataset("glue", "mrpc", split=split)
+    tok = AutoTokenizer.from_pretrained(tokenizer_name)
+    out = []
+    for ex in ds:
+        ids = tok(ex["sentence1"], ex["sentence2"], truncation=True,
+                  max_length=512)["input_ids"]
+        out.append({"input_ids": ids, "labels": int(ex["label"])})
+    return out
+
+
+def make_classification_examples(vocab_size: int, *, n_examples: int = 2048,
+                                 seed: int = 42,
+                                 source: str = "auto") -> list[dict]:
+    """source: "mrpc" (requires network), "synthetic", or "auto" (mrpc
+    with synthetic fallback — the zero-egress default)."""
+    if source not in ("mrpc", "synthetic", "auto"):
+        raise ValueError(f"unknown source {source!r}")
+    if source in ("mrpc", "auto"):
+        try:
+            if source == "auto" and not _hub_reachable():
+                raise OSError("hub unreachable")
+            examples = get_mrpc_examples()
+            too_big = max(max(e["input_ids"]) for e in examples)
+            if too_big >= vocab_size:
+                raise ValueError(
+                    f"MRPC token ids go up to {too_big}, model vocab is "
+                    f"{vocab_size}; use a matching tokenizer or "
+                    f"source='synthetic'")
+            return examples
+        except ValueError:
+            raise
+        except Exception as e:
+            if source == "mrpc":
+                raise
+            print(f"[data] GLUE MRPC unavailable ({type(e).__name__}: {e}); "
+                  f"falling back to synthetic pairs", flush=True)
+    return synthetic_pair_examples(n_examples, vocab_size, seed)
+
+
+def pad_collate(examples: list[dict], *, pad_to_multiple_of: int = 8,
+                pad_id: int = 0) -> dict:
+    """Batch list of examples → padded arrays: pad to the longest sequence
+    rounded UP to a multiple of 8 — the exact semantics of the reference's
+    ``tokenizer.pad(padding="longest", pad_to_multiple_of=8)``
+    (``DDP/ddp.py:64-71``; keeps tensor-core/MXU-friendly shapes and caps
+    XLA recompiles at one per bucketed length)."""
+    longest = max(len(e["input_ids"]) for e in examples)
+    m = pad_to_multiple_of
+    width = -(-longest // m) * m
+    B = len(examples)
+    input_ids = np.full((B, width), pad_id, np.int32)
+    mask = np.zeros((B, width), np.int32)
+    labels = np.empty((B,), np.int32)
+    for i, e in enumerate(examples):
+        ids = e["input_ids"]
+        input_ids[i, :len(ids)] = ids
+        mask[i, :len(ids)] = 1
+        labels[i] = e["labels"]
+    return {"input_ids": input_ids, "attention_mask": mask,
+            "labels": labels}
+
+
+def shard_examples(examples: list, rank: int, ws: int) -> list:
+    """Contiguous per-rank shard, remainder to the LAST rank — the exact
+    reference split (``DDP/ddp.py:104-112``: every rank takes
+    ``len // ws`` except the last, which runs to the end)."""
+    per = len(examples) // ws
+    start = rank * per
+    end = start + per if rank != ws - 1 else len(examples)
+    return examples[start:end]
+
+
+def classification_batches(examples: list[dict], batch_size: int, ws: int,
+                           *, seed: int = 42, epochs: int = 1,
+                           pad_to_multiple_of: int = 8):
+    """Global-batch iterator with per-rank contiguous sharding: each rank
+    draws from ITS shard (shuffled per epoch, drop_last=True as the
+    reference's DataLoader), and the global batch is the rank-major
+    concatenation — handing it to shard_map with in_spec P("dp") gives
+    every rank exactly its own shard's rows.  Collation pads across the
+    whole global batch so ranks agree on the step's padded width (SPMD
+    needs one shape; the reference pays per-rank ragged widths instead)."""
+    rng = np.random.default_rng(seed)
+    shards = [shard_examples(examples, r, ws) for r in range(ws)]
+    per_rank = batch_size // ws
+    if per_rank == 0:
+        raise ValueError(f"batch_size {batch_size} < world size {ws}")
+    steps = min(len(s) for s in shards) // per_rank
+    for _ in range(epochs):
+        orders = [rng.permutation(len(s)) for s in shards]
+        for b in range(steps):
+            chosen = []
+            for r, shard in enumerate(shards):
+                idx = orders[r][b * per_rank:(b + 1) * per_rank]
+                chosen += [shard[i] for i in idx]
+            yield pad_collate(chosen, pad_to_multiple_of=pad_to_multiple_of)

@@ -2,6 +2,9 @@ from .mlp import init_mlp, mlp_apply, zero_toy_mlp, pp_toy_mlp  # noqa: F401
 from .transformer import (  # noqa: F401
     TransformerConfig, SMOLLM3_3B, SMOLLM3_3B_L8, SMOLLM3_350M, TINY_LM,
     init_params, forward, lm_loss, model_flops_per_token)
+from .classifier import (  # noqa: F401
+    init_classifier_params, classifier_logits, classification_loss,
+    classification_accuracy)
 
 # CLI name -> TransformerConfig attribute, shared by every script.
 MODEL_REGISTRY = {

@@ -1,0 +1,65 @@
+"""Sequence classification on the transformer trunk — twin of the
+reference's DDP payload model, ``AutoModelForSequenceClassification``
+over SmolLM2-360M with 2 labels (``DDP/training_utils/utils.py:17-29``).
+
+HF's causal-LM classification recipe, reproduced functionally: run the
+decoder trunk, pool the hidden state of the LAST NON-PAD token, project to
+``num_labels`` logits.  With right padding and causal attention no pad mask
+is needed in the trunk: pads sit *after* the real tokens, and causal
+masking already prevents any real position from attending forward into
+them, so real-token hidden states are bitwise independent of pad content;
+the pooled readout never touches a pad position's state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import transformer as T
+
+
+def init_classifier_params(key: jax.Array, cfg: T.TransformerConfig,
+                           num_labels: int = 2) -> dict:
+    """Trunk params + a zero-init classification head (HF's score layer is
+    a bias-free Linear; zero init gives uniform initial class probs)."""
+    kt, _ = jax.random.split(key)
+    return {
+        "trunk": T.init_params(kt, cfg),
+        "cls_head": jnp.zeros((cfg.hidden_size, num_labels), cfg.dtype),
+    }
+
+
+def classifier_logits(params: dict, input_ids: jax.Array,
+                      attention_mask: jax.Array,
+                      cfg: T.TransformerConfig, *, layer_hook=None):
+    """(B, S) ids + 0/1 mask → (B, num_labels) logits: trunk → last-non-pad
+    pool → head."""
+    h = T.hidden_states(params["trunk"], input_ids, cfg,
+                        layer_hook=layer_hook)          # (B, S, H)
+    last = jnp.maximum(jnp.sum(attention_mask, axis=-1) - 1, 0)  # (B,)
+    pooled = jnp.take_along_axis(
+        h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # (B, H)
+    return pooled @ params["cls_head"].astype(h.dtype)
+
+
+def classification_loss(params: dict, batch, cfg: T.TransformerConfig,
+                        *, layer_hook=None) -> jax.Array:
+    """Mean softmax cross-entropy.  ``batch`` = dict with ``input_ids``
+    (B, S) int32, ``attention_mask`` (B, S) 0/1, ``labels`` (B,) int32 —
+    the collate contract of ``data.classification.pad_collate``."""
+    logits = classifier_logits(params, batch["input_ids"],
+                               batch["attention_mask"], cfg,
+                               layer_hook=layer_hook).astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, batch["labels"][:, None],
+                               axis=-1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def classification_accuracy(params: dict, batch,
+                            cfg: T.TransformerConfig) -> jax.Array:
+    logits = classifier_logits(params, batch["input_ids"],
+                               batch["attention_mask"], cfg)
+    return jnp.mean((jnp.argmax(logits, axis=-1)
+                     == batch["labels"]).astype(jnp.float32))

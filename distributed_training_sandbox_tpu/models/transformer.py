@@ -70,7 +70,8 @@ class TransformerConfig:
     # scaling (forward quantized, backward bf16) — the reference's fp8
     # benchmark knob (fp8_benchmark.py:47) with v5e's native low-precision
     # format.  "int8_pallas" routes through the hand-tiled Pallas kernel.
-    matmul_precision: str = "bf16"  # "bf16" | "int8" | "int8_pallas"
+    # "bf16" | "int8" | "int8_pallas" | "int8_bwd" | "int8_pallas_bwd"
+    matmul_precision: str = "bf16"
     gated_mlp: bool = True  # duck-types as FlopsConfig for utils.flops
 
     @property
@@ -226,13 +227,17 @@ def _attention_flash(q, k, v, scale: float) -> jax.Array:
 
 
 def _dense(cfg: TransformerConfig):
-    """The projection matmul at the configured precision."""
+    """The projection matmul at the configured precision.  Precisions:
+    bf16; int8 (XLA fwd); int8_pallas (fused quantize-matmul kernel fwd);
+    *_bwd variants additionally run both backward matmuls at int8."""
     if cfg.matmul_precision == "bf16":
         return lambda a, w: a @ w
     from ..ops import quant as Q
-    impl = "pallas" if cfg.matmul_precision == "int8_pallas" else "xla"
+    base = cfg.matmul_precision.removesuffix("_bwd")
+    impl = {"int8": "xla", "int8_pallas": "pallas_fused"}[base]
+    quantize_bwd = cfg.matmul_precision.endswith("_bwd")
     interp = jax.default_backend() != "tpu"
-    return lambda a, w: Q.quantized_dense(a, w, impl, interp)
+    return lambda a, w: Q.quantized_dense(a, w, impl, interp, quantize_bwd)
 
 
 def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):

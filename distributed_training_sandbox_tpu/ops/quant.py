@@ -83,7 +83,8 @@ def _qmm_kernel(xq_ref, xs_ref, wq_ref, ws_ref, o_ref):
 @functools.partial(jax.jit, static_argnames=("out_dtype", "block_m",
                                              "block_n", "interpret"))
 def int8_matmul_pallas(xq, xs, wq, ws, *, out_dtype=jnp.bfloat16,
-                       block_m: int = 256, block_n: int = 512,
+                       block_m: int | None = None,
+                       block_n: int | None = None,
                        interpret: bool = False):
     """Tiled Pallas twin of `int8_matmul`: grid over (M/bm, N/bn), full-K
     int8 blocks in VMEM, int32 MXU accumulation, fused dequant epilogue."""
@@ -92,7 +93,7 @@ def int8_matmul_pallas(xq, xs, wq, ws, *, out_dtype=jnp.bfloat16,
     M, K = xq.shape
     K2, N = wq.shape
     assert K == K2, (K, K2)
-    bm, bn = _pick_block(M, block_m, 8), _pick_block(N, block_n, 128)
+    bm, bn = _auto_blocks(M, K, N, 1, block_m or 256, block_n or 512)
     return pl.pallas_call(
         _qmm_kernel,
         grid=(M // bm, N // bn),
@@ -108,37 +109,142 @@ def int8_matmul_pallas(xq, xs, wq, ws, *, out_dtype=jnp.bfloat16,
     )(xq, xs, wq, ws)
 
 
+def _auto_blocks(M: int, K: int, N: int, x_itemsize: int,
+                 target_m: int, target_n: int,
+                 budget: int = 10 << 20) -> tuple[int, int]:
+    """Largest (block_m, block_n) ≤ targets whose working set fits VMEM:
+    double-buffered x block (bm, K), w block (K, bn) int8 and scales, plus
+    the f32 accumulator/output tile.  ~16 MB/core total; budget leaves
+    headroom for Mosaic scratch."""
+    candidates_m = [target_m, 512, 256, 128, 64, 32, 16, 8]
+    candidates_n = [target_n, 512, 256, 128]
+    for tm in candidates_m:
+        for tn in candidates_n:
+            if tm > target_m or tn > target_n:
+                continue
+            bm, bn = _pick_block(M, tm, 8), _pick_block(N, tn, 128)
+            need = 2 * (bm * K * x_itemsize + K * bn + bn * 4) \
+                + bm * bn * 4 + bm * K  # int8 xq scratch
+            if need <= budget:
+                return bm, bn
+    return _pick_block(M, 8, 8), _pick_block(N, 128, 128)
+
+
+def _fused_qmm_kernel(x_ref, wq_ref, ws_ref, o_ref):
+    """Quantize the activation block IN VMEM (per-row absmax over the full
+    K that the block carries), then int8 MXU dot with the pre-quantized
+    weight block and a fused dequant epilogue — the activation never makes
+    an int8 round-trip through HBM."""
+    xf = x_ref[...].astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    xs = jnp.where(amax > 0, amax / 127.0, 1.0)
+    xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+    acc = jnp.dot(xq, wq_ref[...], preferred_element_type=jnp.int32)
+    o_ref[...] = (acc.astype(jnp.float32) * xs * ws_ref[...]
+                  ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "block_m",
+                                             "block_n", "interpret"))
+def int8_matmul_pallas_fused(x, wq, ws, *, out_dtype=jnp.bfloat16,
+                             block_m: int | None = None,
+                             block_n: int | None = None,
+                             interpret: bool = False):
+    """(M,K)bf16 · (K,N)int8 → (M,N): activation quantize fused into the
+    matmul kernel (weights arrive pre-quantized — one pass per step,
+    amortized over the whole M grid).  Block sizes default to the largest
+    VMEM-fitting tiles (blocks carry full K for exact per-row scales)."""
+    from jax.experimental import pallas as pl
+
+    M, K = x.shape
+    K2, N = wq.shape
+    assert K == K2, (K, K2)
+    bm, bn = _auto_blocks(M, K, N, x.dtype.itemsize,
+                          block_m or 256, block_n or 512)
+    return pl.pallas_call(
+        _fused_qmm_kernel,
+        grid=(M // bm, N // bn),
+        in_specs=[
+            pl.BlockSpec((bm, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((K, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        interpret=interpret,
+    )(x, wq, ws)
+
+
+def _int8_dot(aq, a_scale, bq, b_scale, dims, out_dtype):
+    """General int8 dot_general with int32 accumulation; scales must be
+    broadcast-compatible with the (batch..., m, n) result."""
+    acc = lax.dot_general(aq, bq, dims, preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * a_scale * b_scale).astype(out_dtype)
+
+
 # ------------------------------------------------------------- training
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-def quantized_dense(x, w, impl: str = "xla", interpret: bool = False):
-    """Linear layer with int8 forward and straight-through bf16 backward —
-    the Float8Linear training recipe (quantize dynamically, matmul in low
-    precision, backprop in high precision).  ``x``: (..., K), ``w``: (K, N).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def quantized_dense(x, w, impl: str = "xla", interpret: bool = False,
+                    quantize_bwd: bool = False):
+    """Linear layer with int8 forward — the Float8Linear training recipe
+    (quantize dynamically, matmul in low precision).  ``x``: (..., K),
+    ``w``: (K, N).
+
+    impl: "xla" (lax.dot_general), "pallas" (pre-quantized-operand kernel),
+    or "pallas_fused" (activation quantize fused into the kernel).
+
+    quantize_bwd=False: straight-through bf16 backward (fwd-only precision,
+    1/3 of the step's matmul FLOPs run at int8 rate).  True: the two
+    backward matmuls (dX = g·Wᵀ, dW = Xᵀ·g) also run int8 with fresh
+    per-contraction absmax scales — the full torchao dynamic recipe
+    (Float8Linear quantizes grad_output to e5m2 for backward; int8 is the
+    v5e-native analogue), putting ALL step matmul FLOPs at int8 rate.
     """
-    out, _ = _qdense_fwd(x, w, impl, interpret)
+    out, _ = _qdense_fwd(x, w, impl, interpret, quantize_bwd)
     return out
 
 
-def _qdense_fwd(x, w, impl, interpret):
+def _qdense_fwd(x, w, impl, interpret, quantize_bwd):
     lead = x.shape[:-1]
     K = x.shape[-1]
     x2 = x.reshape(-1, K)
-    xq, xs = quantize_int8(x2, axis=-1)
-    wq, ws = quantize_int8(w, axis=0)
-    if impl == "pallas":
-        out = int8_matmul_pallas(xq, xs, wq, ws, out_dtype=x.dtype,
-                                 interpret=interpret)
+    if impl == "pallas_fused":
+        wq, ws = quantize_int8(w, axis=0)
+        out = int8_matmul_pallas_fused(x2, wq, ws, out_dtype=x.dtype,
+                                       interpret=interpret)
     else:
-        out = int8_matmul(xq, xs, wq, ws, out_dtype=x.dtype)
+        xq, xs = quantize_int8(x2, axis=-1)
+        wq, ws = quantize_int8(w, axis=0)
+        if impl == "pallas":
+            out = int8_matmul_pallas(xq, xs, wq, ws, out_dtype=x.dtype,
+                                     interpret=interpret)
+        else:
+            out = int8_matmul(xq, xs, wq, ws, out_dtype=x.dtype)
     return out.reshape(*lead, w.shape[1]), (x, w)
 
 
-def _qdense_bwd(impl, interpret, res, g):
+def _qdense_bwd(impl, interpret, quantize_bwd, res, g):
     x, w = res
-    gx = jnp.einsum("...n,kn->...k", g, w)
-    gw = jnp.einsum("...k,...n->kn", x, g)
-    return gx, gw
+    if not quantize_bwd:
+        gx = jnp.einsum("...n,kn->...k", g, w)
+        gw = jnp.einsum("...k,...n->kn", x, g)
+        return gx, gw
+    lead = x.shape[:-1]
+    K, N = w.shape
+    g2 = g.reshape(-1, N)
+    x2 = x.reshape(-1, K)
+    # dX = g · Wᵀ, contraction over N: g rows / w along its N axis.
+    gq, gs = quantize_int8(g2, axis=-1)                 # (M,N), (M,1)
+    wq_n, ws_n = quantize_int8(w, axis=1)               # (K,N), (K,1)
+    gx = _int8_dot(gq, gs, wq_n, ws_n.T, (((1,), (1,)), ((), ())),
+                   x.dtype)                             # (M,K)
+    # dW = Xᵀ · g, contraction over M: both quantized along M.
+    xq_m, xs_m = quantize_int8(x2, axis=0)              # (M,K), (1,K)
+    gq_m, gs_m = quantize_int8(g2, axis=0)              # (M,N), (1,N)
+    gw = _int8_dot(xq_m, xs_m.T, gq_m, gs_m, (((0,), (0,)), ((), ())),
+                   w.dtype)                             # (K,N)
+    return gx.reshape(*lead, K), gw
 
 
 quantized_dense.defvjp(_qdense_fwd, _qdense_bwd)

@@ -60,7 +60,8 @@ def build_argparser(parser: argparse.ArgumentParser | None = None):
     # `--precision fp8` flag in fsdp/ is declared-but-ignored (its quirk #9,
     # SURVEY.md §2.9) — int8 is the implemented low-precision path here.
     p.add_argument("--precision", dest="precision",
-                   choices=["bf16", "fp32", "int8", "int8_pallas"],
+                   choices=["bf16", "fp32", "int8", "int8_pallas",
+                            "int8_bwd", "int8_pallas_bwd"],
                    default=None)
     p.add_argument("--seed", dest="seed", type=int, default=None)
     p.add_argument("--run-name", dest="run_name", type=str, default=None)

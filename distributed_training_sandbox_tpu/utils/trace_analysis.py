@@ -1,0 +1,114 @@
+"""Post-hoc comm-vs-compute split from XLA profiler traces.
+
+Twin of the reference's in-step communication timers
+(``zero/zero2.py:91-135,219-228``: cuda-synchronized stopwatches around each
+``dist`` call, printed as "communication overhead %").  Under jit there is
+nothing to stopwatch — collectives are ops inside one compiled program — so
+the split is recovered from the profiler trace instead: sum the durations of
+collective-ish ops vs compute-ish ops in the chrome-trace JSON that
+``jax.profiler`` writes (``plugins/profile/<ts>/*.trace.json.gz``).
+
+Methodology notes (honest limits):
+  * Trace events are HLO instructions; names keep their primitive root
+    ("psum.7", "all-reduce.3", "fusion.12"), so classification is by name
+    pattern.  Collective wait time shows up as Rendezvous (CPU backend) /
+    megacore-fusion-wait (TPU) and counts as comm.
+  * On overlap-capable hardware comm hidden under compute still counts
+    toward comm time — the split is "time attributable to", not "critical
+    path", matching what the reference's blocking timers measured.
+  * Infra events (thread waits, host python, dispatch) belong to neither
+    bucket and are excluded from the denominator.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from dataclasses import dataclass
+
+_COMM = re.compile(
+    r"(all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter|all[-_]?to[-_]?all"
+    r"|collective[-_]?permute|psum|ppermute|rendezvous|send|recv)",
+    re.IGNORECASE)
+_COMPUTE = re.compile(
+    r"(^dot|\bdot\b|fusion|convolution|cumsum|reduce|transpose|copy|scatter"
+    r"|gather|broadcast_in_dim|select|compare|add|multiply|divide|subtract"
+    r"|exponential|log|rsqrt|tanh|iota|concatenate|slice|dynamic|pad|while"
+    r"|convert|bitcast|clamp|maximum|minimum|negate|power|remainder|sign"
+    r"|custom[-_]?call|tpu[-_]?custom)",
+    re.IGNORECASE)
+_IGNORE = re.compile(
+    r"(Wait|PjitFunction|PjRt|block_until_ready|try_to_block|shard_arg"
+    r"|\$|rendezvous callback|process_name|thread_name|program_interface)",
+    re.IGNORECASE)
+
+
+@dataclass
+class CommSplit:
+    comm_us: float
+    compute_us: float
+    other_us: float
+    trace_file: str
+    top_comm: list
+    top_compute: list
+
+    @property
+    def total_us(self) -> float:
+        return self.comm_us + self.compute_us
+
+    @property
+    def comm_fraction(self) -> float:
+        return self.comm_us / self.total_us if self.total_us else 0.0
+
+    def report(self, label: str = "") -> str:
+        """The reference's print format (zero2.py:219-228): absolute times
+        + overhead %."""
+        pct = 100.0 * self.comm_fraction
+        return (f"[{label}] comm/compute split (profiler trace): "
+                f"comm {self.comm_us / 1e3:.2f} ms, "
+                f"compute {self.compute_us / 1e3:.2f} ms "
+                f"-> communication overhead {pct:.1f}% of categorized "
+                f"device time")
+
+
+def latest_trace_file(trace_dir: str) -> str | None:
+    files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    return max(files, key=os.path.getmtime) if files else None
+
+
+def split_from_trace(trace_dir: str, top_n: int = 5) -> CommSplit | None:
+    """Analyze the newest trace under ``trace_dir``.  Returns None when no
+    trace exists (profiling disabled / single uncaptured step)."""
+    tf = latest_trace_file(trace_dir)
+    if tf is None:
+        return None
+    events = json.load(gzip.open(tf, "rt"))["traceEvents"]
+    comm: dict[str, float] = {}
+    compute: dict[str, float] = {}
+    other = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name", "")
+        dur = float(e.get("dur", 0.0))
+        if _IGNORE.search(name):
+            continue
+        if _COMM.search(name):
+            comm[name] = comm.get(name, 0.0) + dur
+        elif _COMPUTE.search(name):
+            compute[name] = compute.get(name, 0.0) + dur
+        else:
+            other += dur
+    top = lambda d: sorted(d.items(), key=lambda kv: -kv[1])[:top_n]
+    return CommSplit(
+        comm_us=sum(comm.values()),
+        compute_us=sum(compute.values()),
+        other_us=other,
+        trace_file=tf,
+        top_comm=top(comm),
+        top_compute=top(compute),
+    )

@@ -3,7 +3,9 @@ the ~200 lines of train/profile boilerplate each reference zero file repeats
 (SURVEY.md §2.8).  Flow mirrors ``test_zeroN()`` (``zero/zero1.py:203,331``):
 one process runs a baseline-Adam leg, then the sharded leg on an
 identically-seeded model, and prints the per-device optimizer-memory delta as
-the pass signal, plus step timing, an estimated comm/compute split, and the
+the pass signal, plus step timing, the comm/compute split recovered from the
+leg's profiler trace (``utils.trace_analysis`` — the jit-world twin of the
+reference's in-step communication timers, ``zero/zero2.py:219-228``), and the
 per-step HLO collective counts (the trace-parity upgrade).
 """
 
@@ -58,6 +60,8 @@ def run_zero_ab(stage: int, argv=None):
     from distributed_training_sandbox_tpu.utils import (
         TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
         tree_size_mb, tree_local_size_mb, print_memory_stats)
+    from distributed_training_sandbox_tpu.utils.trace_analysis import (
+        split_from_trace)
     from distributed_training_sandbox_tpu.models import zero_toy_mlp
     from distributed_training_sandbox_tpu.models.mlp import mse_loss
     from distributed_training_sandbox_tpu.parallel import make_ddp_train_step, optim
@@ -136,6 +140,15 @@ def run_zero_ab(stage: int, argv=None):
           f"sharded {shard_dt * 1e3:.2f} ms")
     print(f"[{name}] per-step collectives baseline: {base_counts}")
     print(f"[{name}] per-step collectives sharded:  {shard_counts}")
+    splits = {}
+    if cfg.profile:
+        for leg in ("baseline", "sharded"):
+            sp = split_from_trace(f"{cfg.trace_dir}/{name}/{leg}")
+            if sp:
+                print(sp.report(f"{name}/{leg}"))
+                splits[leg] = {"comm_us": sp.comm_us,
+                               "compute_us": sp.compute_us,
+                               "comm_fraction": sp.comm_fraction}
     drift = float(np.max(np.abs(np.array(base_losses) - np.array(shard_losses))))
     print(f"[{name}] loss drift baseline-vs-sharded: {drift:.2e} "
           f"({'OK' if drift < 1e-3 else 'DIVERGED'})")
@@ -146,4 +159,5 @@ def run_zero_ab(stage: int, argv=None):
         "base_ms": base_dt * 1e3, "shard_ms": shard_dt * 1e3,
         "base_counts": base_counts, "shard_counts": shard_counts,
         "loss_drift": float(drift),
+        "comm_split": splits,
     }

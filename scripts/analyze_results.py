@@ -1,0 +1,148 @@
+"""Post-hoc results analysis — the committed twin of the reference's
+``fp8/visualize_code.ipynb`` (cells 1, 7-10: regex-harvest run logs →
+pandas → TFLOPS / tok/s comparison plots).
+
+Reads the machine-readable artifacts the benchmark scripts write —
+``precision_results/summary_*.json`` (precision sweeps) and
+``pp_results/*.json`` (GPipe/1F1B runs) — and regenerates comparison
+tables (tok/s, TFLOPS/device, peak memory by model × seq × precision;
+schedule metrics for pp) as one markdown report.  One command, committed
+inputs, reproducible output:
+
+  python scripts/analyze_results.py [--precision-dir precision_results]
+      [--pp-dir pp_results] [--out RESULTS.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+from pathlib import Path
+
+# Prepend the checkout root so the source tree always wins over any
+# installed copy of the package (`pip install -e .` makes this a no-op).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def load_precision(dirname: str) -> list[dict]:
+    rows = []
+    for f in sorted(glob.glob(f"{dirname}/summary_*.json")):
+        rows.extend(json.load(open(f)))
+    # last write wins per (model, precision, seq, devices) key
+    dedup = {}
+    for r in rows:
+        dedup[(r["model"], r["precision"], r["sequence_length"],
+               r["num_devices"])] = r
+    return list(dedup.values())
+
+
+def precision_tables(rows: list[dict]) -> str:
+    if not rows:
+        return "_no precision summaries found_\n"
+    models = sorted({r["model"] for r in rows})
+    seqs = sorted({r["sequence_length"] for r in rows})
+    precisions = list(dict.fromkeys(r["precision"] for r in rows))
+    by = {(r["model"], r["precision"], r["sequence_length"]): r
+          for r in rows}
+    out = []
+    for metric, fmt, title in (
+            ("tokens_per_second", "{:.0f}", "tokens/sec"),
+            ("tflops_per_device", "{:.2f}", "TFLOPS/device"),
+    ):
+        out.append(f"### {title}\n")
+        header = "| model | seq | " + " | ".join(precisions) \
+            + " | best int8 vs bf16 |"
+        out += [header, "|" + "---|" * (len(precisions) + 3)]
+        for m in models:
+            for s in seqs:
+                cells = [m, str(s)]
+                vals = {}
+                for p in precisions:
+                    r = by.get((m, p, s))
+                    vals[p] = r[metric] if r else None
+                    cells.append(fmt.format(r[metric]) if r else "—")
+                ints = [v for k, v in vals.items()
+                        if k != "bf16" and v is not None]
+                if vals.get("bf16") and ints:
+                    cells.append(f"{max(ints) / vals['bf16']:+.1%}"
+                                 .replace("+", "+" if max(ints) >= vals["bf16"]
+                                          else ""))
+                else:
+                    cells.append("—")
+                out.append("| " + " | ".join(cells) + " |")
+        out.append("")
+    out.append("### peak memory (model + optimizer, MB per device)\n")
+    out += ["| model | seq | precision | model MB | optimizer MB |",
+            "|---|---|---|---|---|"]
+    for m in models:
+        for s in seqs:
+            for p in precisions:
+                r = by.get((m, p, s))
+                if r:
+                    pm = r.get("peak_memory", {})
+                    out.append(f"| {m} | {s} | {p} | "
+                               f"{pm.get('model_mb', 0):.0f} | "
+                               f"{pm.get('optimizer_mb', 0):.0f} |")
+    out.append("")
+    return "\n".join(out)
+
+
+def load_pp(dirname: str) -> list[dict]:
+    rows = []
+    for f in sorted(glob.glob(f"{dirname}/*.json")):
+        d = json.load(open(f))
+        rows.extend(d if isinstance(d, list) else [d])
+    return [r for r in rows if "schedule" in r]
+
+
+def pp_table(rows: list[dict]) -> str:
+    if not rows:
+        return "_no pp result JSONs found_\n"
+    out = ["| schedule | final loss | avg loss | avg epoch s | epochs/s | "
+           "total peak MB |",
+           "|---|---|---|---|---|---|"]
+    for r in rows:
+        out.append(f"| {r['schedule']} | {r['final_loss']:.6f} | "
+                   f"{r['avg_loss']:.6f} | {r['avg_epoch_time_s']:.3f} | "
+                   f"{r['epochs_per_s']:.2f} | "
+                   f"{r.get('total_peak_memory_mb', 0):.1f} |")
+    out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--precision-dir", default="precision_results")
+    p.add_argument("--pp-dir", default="pp_results")
+    p.add_argument("--out", default="RESULTS.md")
+    args = p.parse_args(argv)
+
+    prec = load_precision(args.precision_dir)
+    pp = load_pp(args.pp_dir)
+    doc = [
+        "# Benchmark results",
+        "",
+        "Regenerated from committed JSON artifacts by "
+        "`python scripts/analyze_results.py` — the twin of the reference's "
+        "`fp8/visualize_code.ipynb` analysis pass.",
+        "",
+        "## Precision sweep (model × seq × precision)",
+        "",
+        "`int8` = dynamic-absmax int8 forward matmuls; `int8_bwd` "
+        "additionally quantizes both backward matmuls (the full torchao "
+        "dynamic recipe at v5e's native low precision).",
+        "",
+        precision_tables(prec),
+        "## Pipeline schedules (GPipe vs 1F1B)",
+        "",
+        pp_table(pp),
+    ]
+    Path(args.out).write_text("\n".join(doc))
+    print(f"[analyze] {len(prec)} precision rows, {len(pp)} pp rows "
+          f"-> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -3,13 +3,21 @@
 Same flow: broadcast params from rank 0 + sync assertion, per-step local
 forward/backward, per-param gradient all_reduce + average, SGD update,
 rank-0 profiler over a skip/wait/warmup/active schedule, per-step barrier.
-Twin differences: the model is the toy MLP (the reference's GLUE-MRPC
-SmolLM2 path needs a hub download; `scripts/train_fsdp.py` covers the real-LM
-path), and collective counts are printed from the lowered HLO instead of
-eyeballed from NCCL traces.
+Collective counts are printed from the lowered HLO instead of eyeballed
+from NCCL traces.
+
+Two payloads, selected by ``--model``:
+  * ``mlp`` (default): the toy regression MLP (synthetic randn batches);
+  * ``smollm3-350m`` / ``tiny``: the real-data path — a 350M-class
+    transformer trunk + classification head over MRPC-style sentence pairs
+    with the reference's pad-to-multiple-of-8 collate and per-rank
+    contiguous dataset sharding (``DDP/ddp.py:58-126``,
+    ``DDP/training_utils/utils.py:17-107``; GLUE MRPC gated behind network,
+    deterministic synthetic pairs offline).
 
 Usage:
   python scripts/ddp.py --num-steps 20 [--cpu-devices 8] [--scale 20]
+  python scripts/ddp.py --model smollm3-350m --num-steps 20 [--batch-size 32]
 """
 
 from __future__ import annotations
@@ -30,11 +38,18 @@ def main(argv=None):
                    help="simulate N CPU devices (the gloo-mode twin)")
     p.add_argument("--scale", type=int, default=20,
                    help="divide toy-MLP width by this (20 -> 500-wide)")
+    p.add_argument("--model", choices=["mlp", "smollm3-350m", "tiny"],
+                   default="mlp",
+                   help="mlp = toy regression; otherwise the MRPC-style "
+                        "classification path on that transformer config")
     args, rest = p.parse_known_args(argv)
 
     if args.cpu_devices:
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(args.cpu_devices)
+
+    if args.model != "mlp":
+        return classification_main(args, rest)
 
     import jax
     import jax.numpy as jnp
@@ -110,6 +125,110 @@ def main(argv=None):
         print(f"[ddp] steps/s {metrics['steps_per_second']:.2f} "
               f"avg_loss {metrics.get('avg_loss', float('nan')):.6f}")
     print(f"[ddp] traces in {cfg.trace_dir}" if cfg.profile else "[ddp] done")
+    return metrics
+
+
+def classification_main(args, rest):
+    """The real-data leg: 350M-class trunk + classification head, padded
+    sentence pairs, same DDP choreography (broadcast + assert, per-param
+    grad all_reduce, SGD — reference ``DDP/ddp.py:84-126``)."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_training_sandbox_tpu.utils import (
+        TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
+        PerformanceTracker, print_memory_stats, annotate)
+    from distributed_training_sandbox_tpu.models import (
+        transformer as T, init_classifier_params, classification_loss,
+        classification_accuracy, MODEL_REGISTRY)
+    from distributed_training_sandbox_tpu.parallel import (
+        make_ddp_train_step, broadcast_params, params_sync_error, optim)
+    from distributed_training_sandbox_tpu.data import (
+        make_classification_examples, classification_batches)
+    from distributed_training_sandbox_tpu.ops import smap, count_collectives
+    from jax.sharding import PartitionSpec as P
+    import functools
+
+    # per-device bs 32 tuned for A10G in the reference (DDP/ddp.py:99);
+    # the global default here is 32 total, overridable via --batch-size.
+    cfg = TrainConfig.from_args(rest, batch_size=32)
+    mcfg: T.TransformerConfig = getattr(T, MODEL_REGISTRY[args.model])
+    mesh = make_mesh()
+    ws = get("ws")
+    if cfg.batch_size % ws:
+        raise SystemExit(f"--batch-size {cfg.batch_size} must be divisible "
+                         f"by device count {ws}")
+    print(f"[ddp] model={args.model} ({mcfg.param_count()/1e9:.3f}B) "
+          f"mesh={dict(mesh.shape)} platform={jax.devices()[0].platform}")
+
+    key = set_seed(cfg.seed)
+    params = init_classifier_params(key, mcfg)
+
+    bcast = jax.jit(smap(lambda p: broadcast_params(p, "dp"),
+                         mesh, P(), P()))
+    params = bcast(params)
+    err = float(jax.jit(smap(lambda p: params_sync_error(p, "dp"),
+                             mesh, P(), P()))(params))
+    assert err == 0.0, f"params diverged across replicas: {err}"
+    print(f"[ddp] param sync check passed (divergence {err})")
+
+    examples = make_classification_examples(mcfg.vocab_size)
+    print(f"[ddp] dataset: {len(examples)} examples "
+          f"(per-rank contiguous shards, pad-to-multiple-of-8 collate)")
+
+    opt_state = optim.sgd_init(params)
+    loss_fn = functools.partial(classification_loss, cfg=mcfg)
+    step = make_ddp_train_step(
+        lambda p, b: loss_fn(p, b),
+        lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3),
+        mesh, "dp")
+
+    batches = classification_batches(
+        examples, cfg.batch_size, ws, seed=cfg.seed,
+        epochs=max(cfg.num_epochs, 1 + cfg.num_steps * cfg.batch_size
+                   // max(len(examples), 1)))
+    first = next(batches)
+    counts = count_collectives(
+        step, params, opt_state,
+        {k: jnp.asarray(v) for k, v in first.items()})
+    n_leaves = len(jax.tree.leaves(params))
+    print(f"[ddp] per-step collectives (HLO): {counts} "
+          f"(expect {n_leaves} grad all_reduces + loss mean + barrier)")
+
+    tracker = PerformanceTracker(warmup_steps=min(3, cfg.num_steps - 1) if
+                                 cfg.num_steps > 1 else 0)
+    prof = Profiler(trace_dir=cfg.trace_dir,
+                    schedule=ProfileSchedule(skip_first=5, wait=1, warmup=2,
+                                             active=5)) if cfg.profile else None
+    metrics = None
+    batch = first
+    for i in range(cfg.num_steps):
+        with annotate("data_movement"):
+            jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+        params, opt_state, loss = step(params, opt_state, jbatch)
+        jax.block_until_ready(loss)
+        metrics = tracker.step(int(jbatch["input_ids"].size),
+                               loss=float(loss))
+        if prof:
+            prof.step()
+        if i % 5 == 0 or i == cfg.num_steps - 1:
+            print(f"[ddp] step {i:3d} loss {float(loss):.4f} "
+                  f"(padded width {jbatch['input_ids'].shape[1]})")
+        try:
+            batch = next(batches)
+        except StopIteration:
+            break
+    if prof:
+        prof.stop()
+
+    acc_fn = jax.jit(lambda p, b: classification_accuracy(p, b, mcfg))
+    acc = float(acc_fn(params, {k: jnp.asarray(v)
+                                for k, v in first.items()}))
+    print_memory_stats("ddp-cls-final", params=params, opt_state=opt_state)
+    if metrics:
+        print(f"[ddp] steps/s {metrics['steps_per_second']:.2f} "
+              f"tok/s {metrics['tokens_per_second']:.0f} "
+              f"avg_loss {metrics.get('avg_loss', float('nan')):.4f} "
+              f"train-batch acc {acc:.3f}")
     return metrics
 
 

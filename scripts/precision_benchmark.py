@@ -31,7 +31,9 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from distributed_training_sandbox_tpu.models import MODEL_REGISTRY as MODELS  # noqa: E402
 
 SWEEP_SEQS = (2048, 4096, 8192)           # fp8/modal_app.py:90
-SWEEP_PRECISIONS = ("bf16", "int8")
+# {bf16, fp8} in the reference (fp8/modal_app.py:90-110); the v5e twin adds
+# the full-int8 recipe (backward matmuls quantized too) as the headline.
+SWEEP_PRECISIONS = ("bf16", "int8", "int8_bwd")
 
 
 def run_one(model: str, precision: str, seq_len: int, num_steps: int,
@@ -47,10 +49,8 @@ def run_one(model: str, precision: str, seq_len: int, num_steps: int,
     from distributed_training_sandbox_tpu.data import make_packed_dataset
 
     mcfg: T.TransformerConfig = getattr(T, MODELS[model])
-    precision_fields = {"bf16": "bf16", "int8": "int8",
-                        "int8_pallas": "int8_pallas"}
     mcfg = dataclasses.replace(
-        mcfg, matmul_precision=precision_fields[precision],
+        mcfg, matmul_precision=precision,
         attention_impl="flash" if jax.default_backend() == "tpu" else "xla")
     mesh = make_mesh()
     ws = int(mesh.devices.size)
@@ -110,7 +110,8 @@ def main(argv=None):
     p.add_argument("--cpu-devices", type=int, default=0)
     p.add_argument("--model", choices=sorted(MODELS), default="tiny")
     p.add_argument("--precision",
-                   choices=["bf16", "int8", "int8_pallas"], default="bf16")
+                   choices=["bf16", "int8", "int8_pallas", "int8_bwd",
+                            "int8_pallas_bwd"], default="bf16")
     p.add_argument("--sequence-length", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--num-steps", type=int, default=12)

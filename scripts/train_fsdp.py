@@ -68,7 +68,7 @@ def main(argv=None):
         mcfg = dataclasses.replace(mcfg, remat_policy=args.remat_policy)
     # Consume the shared --precision knob (the reference's fsdp dir declares
     # `--precision fp8` and ignores it — its quirk #9; this one is real).
-    if cfg.precision in ("int8", "int8_pallas"):
+    if cfg.precision.startswith("int8"):
         mcfg = dataclasses.replace(mcfg, matmul_precision=cfg.precision)
     elif cfg.precision == "fp32":
         mcfg = dataclasses.replace(mcfg, dtype=jnp.float32)
@@ -130,6 +130,11 @@ def main(argv=None):
             print(f"[fsdp] step {i:3d} loss {float(loss):.4f}")
     if prof:
         prof.stop()
+        from distributed_training_sandbox_tpu.utils.trace_analysis import (
+            split_from_trace)
+        sp = split_from_trace(cfg.trace_dir)
+        if sp:
+            print(sp.report("fsdp"))
 
     print_memory_stats("fsdp-final", params=shards, opt_state=opt_state)
     if metrics:

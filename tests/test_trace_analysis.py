@@ -1,0 +1,81 @@
+"""Comm-vs-compute split from profiler traces (utils.trace_analysis) — the
+twin of the reference's in-optimizer communication timers
+(``zero/zero2.py:219-228``)."""
+
+import gzip
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from distributed_training_sandbox_tpu.ops import collectives as C
+from distributed_training_sandbox_tpu.utils.trace_analysis import (
+    split_from_trace)
+
+
+def _write_trace(tmp_path, events):
+    d = tmp_path / "plugins" / "profile" / "2026_01_01"
+    d.mkdir(parents=True)
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return tmp_path
+
+
+def _ev(name, dur):
+    return {"ph": "X", "name": name, "dur": dur, "ts": 0, "pid": 1, "tid": 1}
+
+
+def test_split_classification(tmp_path):
+    _write_trace(tmp_path, [
+        _ev("all-reduce.3", 100), _ev("psum.7", 50), _ev("Rendezvous", 25),
+        _ev("fusion.12", 200), _ev("dot", 100),
+        _ev("Wait: pending_threads=2/8", 999),     # infra: ignored
+        _ev("PjitFunction(step)", 999),            # infra: ignored
+    ])
+    sp = split_from_trace(str(tmp_path))
+    assert sp.comm_us == 175
+    assert sp.compute_us == 300
+    assert sp.comm_fraction == 175 / 475
+    assert "overhead" in sp.report("t")
+
+
+def test_comm_patterns_win_over_compute():
+    """all-gather / reduce-scatter must classify as comm even though
+    'gather'/'reduce'/'scatter' also appear in the compute pattern."""
+    import tempfile, pathlib
+    with tempfile.TemporaryDirectory() as td:
+        p = pathlib.Path(td)
+        _write_trace(p, [_ev("all-gather.1", 10),
+                         _ev("reduce-scatter.2", 10),
+                         _ev("all-to-all.4", 10),
+                         _ev("collective-permute.9", 10),
+                         _ev("gather.3", 7), _ev("scatter.5", 7),
+                         _ev("reduce.6", 7)])
+        sp = split_from_trace(td)
+        assert sp.comm_us == 40
+        assert sp.compute_us == 21
+
+
+def test_no_trace_returns_none(tmp_path):
+    assert split_from_trace(str(tmp_path)) is None
+
+
+def test_split_from_real_trace(tmp_path, mesh8):
+    """End-to-end: trace a collective-heavy jit and recover a split with
+    nonzero comm."""
+    f = jax.jit(C.smap(lambda x: C.all_reduce(x @ x.T, "dp"),
+                       mesh8, P("dp"), P()))
+    x = jnp.ones((8, 128, 128))
+    jax.block_until_ready(f(x))  # compile outside the trace
+    jax.profiler.start_trace(str(tmp_path))
+    for _ in range(3):
+        out = f(x)
+    jax.block_until_ready(out)
+    jax.profiler.stop_trace()
+    sp = split_from_trace(str(tmp_path))
+    assert sp is not None
+    assert sp.comm_us > 0
+    assert sp.compute_us > 0
+    assert 0.0 < sp.comm_fraction < 1.0
