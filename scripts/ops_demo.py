@@ -1,0 +1,301 @@
+"""02-operations teaching twin: every collective, live on an 8-device mesh.
+
+The reference teaches its communication layer interactively in
+``02-operations.ipynb`` (cells 2-42): two ``nbdistributed`` ranks walk
+through send/recv, isend/irecv + wait, broadcast, scatter, all_reduce
+(SUM/MAX/MIN/PRODUCT), reduce-to-one, and both all_gather flavors, printing
+each tensor before and after the op.  This script is the TPU-native twin of
+that notebook (SURVEY.md §2.6): the same progression — point-to-point →
+one-to-all → reductions → gathers — demonstrated with this framework's own
+collectives layer (``ops/collectives.py``) on an 8-device
+``jax.sharding.Mesh``, plus the TPU-only extras the reference's course
+builds toward (reduce_scatter, all_to_all, barrier).
+
+Where the notebook prints per-rank tensors, we print per-device shards; where
+it relies on the reader imagining the layout, we show it with
+``jax.debug.visualize_array_sharding``.  Two deliberate differences from the
+torch mental model, called out inline:
+
+  * There are no per-rank Python processes.  One program runs on all devices
+    (SPMD); "rank" is ``lax.axis_index`` *inside* the traced computation, and
+    per-rank branching is ``jnp.where`` / masking, not ``if rank == 0:``.
+  * Every JAX dispatch is already asynchronous — the isend/irecv/wait
+    progression (nb cells 16-21) maps to "dispatch, then
+    ``block_until_ready``", demonstrated in §2.
+
+Runs top-to-bottom offline: with fewer than 8 real devices it forces an
+8-device CPU-sim platform (the repo's gloo-mode twin, SURVEY.md §4).
+
+    python scripts/ops_demo.py
+"""
+
+from __future__ import annotations
+
+import io
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+SEP = "─" * 72
+
+
+def _banner(title: str, body: str = "") -> None:
+    print(f"\n{SEP}\n{title}\n{SEP}")
+    if body:
+        print(body.strip() + "\n")
+
+
+def tinfo(name: str, arr, *, values: bool = True) -> None:
+    """Twin of the notebook's ``tinfo`` helper (cell 8): shape / dtype /
+    placement / value — here one line per device shard instead of one print
+    per rank process."""
+    import numpy as np
+    print(f"  {name}: shape={tuple(arr.shape)} dtype={arr.dtype}")
+    for s in sorted(arr.addressable_shards, key=lambda s: s.index):
+        dev = f"{s.device.platform}:{s.device.id}"
+        val = np.asarray(s.data).ravel()
+        txt = np.array2string(val, max_line_width=60, threshold=8)
+        print(f"    device {dev}  shard{s.index}  " +
+              (txt if values else f"shape={s.data.shape}"))
+
+
+def viz(arr) -> None:
+    """``jax.debug.visualize_array_sharding`` with a fallback for >2-D /
+    exotic layouts (the visualizer only draws 1-D/2-D arrays)."""
+    import jax
+    try:
+        jax.debug.visualize_array_sharding(arr)
+    except (ValueError, NotImplementedError):
+        print(f"  [sharding: {arr.sharding}]")
+
+
+def _real_device_count() -> int:
+    """Count devices in a subprocess: probing in-process would initialize
+    the backend and make a later use_cpu_devices() a no-op."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, text=True, timeout=120)
+        return int(r.stdout.strip().splitlines()[-1])
+    except Exception:
+        return 0
+
+
+def main() -> dict:
+    """Run the whole walkthrough; returns computed results keyed by section
+    so the test suite can assert semantics, not just 'it printed'."""
+    # §0 — %dist_init twin: bring up the device "world" ------------------
+    if _real_device_count() < 8:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(8)
+    import jax
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_training_sandbox_tpu.ops import collectives as C
+    from distributed_training_sandbox_tpu.utils import make_mesh
+
+    mesh = make_mesh({"dev": -1}, register=False)
+    n = int(mesh.shape["dev"])
+    results: dict = {}
+
+    _banner(
+        "§0  World setup — the %dist_init twin (nb cell 2)",
+        f"""
+The notebook spawns {2} worker processes and gives each a CUDA device.
+JAX's SPMD model needs no worker processes: one program, {n} devices, one
+named Mesh.  Everything below runs inside shard_map over this mesh, where
+`lax.axis_index("dev")` plays the role of `rank`.""")
+    print(f"  mesh: {mesh}")
+    print(f"  devices: {[f'{d.platform}:{d.id}' for d in mesh.devices.ravel()]}")
+
+    shard = NamedSharding(mesh, P("dev"))
+    repl = NamedSharding(mesh, P())
+
+    # §1 — point-to-point: send/recv as a ring permute (nb cells 11-14) ---
+    _banner(
+        "§1  Point → point: send/recv (nb cells 11-14)",
+        """
+torch: rank0 `dist.send(t, dst=1)`, rank1 `dist.recv(t, src=0)`.
+SPMD has no one-sided send; the collective form of "device i sends to
+device j" is `lax.ppermute`, here a +1 ring so every device passes its
+payload to its neighbour.  Each device's payload is `[rank, rank, rank]`;
+after the hop, device i holds the values of device i-1.""")
+    payload = jax.device_put(
+        np.repeat(np.arange(n, dtype=np.float32), 3).reshape(n, 3), shard)
+    print("before (each device holds its own rank):")
+    tinfo("payload", payload)
+    viz(payload)
+    ring = jax.jit(C.smap(lambda x: C.ppermute_ring(x[0], "dev", shift=1)[None],
+                          mesh, in_specs=P("dev"), out_specs=P("dev")))
+    moved = ring(payload)
+    print("after ppermute_ring(shift=1) (each device holds rank-1's data):")
+    tinfo("payload", moved)
+    results["ppermute"] = np.asarray(moved)
+
+    # §2 — async: isend/irecv/wait ↔ dispatch + block_until_ready --------
+    _banner(
+        "§2  Asynchronous ops — isend/irecv + wait (nb cells 16-22)",
+        """
+torch: `request = dist.isend(...)` ... `request.wait()`.
+Every JAX op is dispatched asynchronously already: the call returns a
+future-like Array immediately and the host keeps running — the notebook's
+"overlap compute with communication" goal is the default.  The twin of
+`request.wait()` is `jax.block_until_ready(x)`.""")
+    fut = ring(moved)          # dispatched; host is NOT blocked here
+    print("  dispatched ring hop; host continues immediately "
+          "(overlapped compute happens here)")
+    fut = jax.block_until_ready(fut)   # request.wait()
+    print("  block_until_ready(...) returned — transfer complete:")
+    tinfo("payload", fut)
+    results["async"] = np.asarray(fut)
+
+    # §3 — one → all: broadcast (nb cells 3-5, 24-26) --------------------
+    _banner(
+        "§3  One → all: broadcast (nb cells 3-5, 24-26)",
+        """
+torch: rank0 holds [1,2,3], rank1 holds empty; `dist.broadcast(t, src=0)`.
+Here every device enters with its own distinct row (rank*10 + [1,2,3]) and
+leaves with device 0's row.  The wrapper implements broadcast as a masked
+psum — one all-reduce on the wire, which is exactly how NCCL accounts small
+broadcasts too (reference README.md:11-12).""")
+    distinct = jax.device_put(
+        (np.arange(n, dtype=np.float32)[:, None] * 10
+         + np.array([1.0, 2.0, 3.0])), shard)
+    print("before (every device has its own row):")
+    tinfo("t", distinct)
+    bcast = jax.jit(C.smap(lambda x: C.broadcast(x, "dev", root=0),
+                           mesh, in_specs=P("dev"), out_specs=P("dev")))
+    after = bcast(distinct)
+    print("after broadcast(root=0) (everyone has device 0's row):")
+    tinfo("t", after)
+    results["broadcast"] = np.asarray(after)
+
+    # §4 — one → all: scatter (nb cells 28-30) ---------------------------
+    _banner(
+        "§4  One → all: scatter (nb cells 28-30)",
+        """
+torch: rank0 builds `[tensor([0,1]), tensor([2,3])]`, `dist.scatter` hands
+one chunk to each rank.  The SPMD formulation: the source tensor is
+(logically) everywhere, each device slices its own chunk.  In the global
+view that IS what `device_put` with a sharded layout does — watch the
+sharding visualization: one replicated array in, a dim-0-sharded array out.""")
+    src = jax.device_put(np.arange(2 * n, dtype=np.int32), repl)
+    print("before: source replicated on all devices")
+    viz(src)
+    scat = jax.jit(C.smap(lambda x: C.scatter(x, "dev")[None],
+                          mesh, in_specs=P(), out_specs=P("dev")))
+    chunks = scat(src)
+    print("after scatter: each device owns a 2-element chunk:")
+    tinfo("chunk", chunks)
+    viz(chunks.reshape(n * 2))
+    results["scatter"] = np.asarray(chunks)
+
+    # §5 — all → all reductions: SUM / MAX / MIN / PRODUCT (cells 33-36) -
+    _banner(
+        "§5  All → all reductions: all_reduce (nb cells 33-36)",
+        """
+torch: every rank holds `[0+rank, 1+rank, 2+rank]`, then all_reduce with
+SUM, MAX, MIN, PRODUCT.  Same data here — note PRODUCT has no XLA
+primitive; the wrapper builds it from three psums (sign / zero / log-sum),
+a teaching-op only (see ops/collectives.py).""")
+    base = jax.device_put(
+        (np.arange(n, dtype=np.float32)[:, None]
+         + np.arange(3, dtype=np.float32)), shard)
+    print("before (rank r holds [r, r+1, r+2]):")
+    tinfo("t", base)
+    for op in ("sum", "max", "min", "prod"):
+        f = jax.jit(C.smap(lambda x, op=op: C.all_reduce(x[0], "dev", op)[None],
+                           mesh, in_specs=P("dev"), out_specs=P("dev")))
+        out = f(base)
+        row = np.asarray(out)[0]
+        print(f"  all_reduce({op.upper():7s}) -> every device: {row}")
+        results[f"all_reduce_{op}"] = np.asarray(out)
+
+    # §6 — all → one: reduce to a root (nb cell 38) ----------------------
+    _banner(
+        "§6  All → one: reduce (nb cell 38)",
+        """
+torch: `dist.reduce(t, dst=0)` — only rank 0 gets the sum ("useful for
+metrics printed only on rank0").  SPMD twin: psum + keep-if-root mask; the
+non-root devices deliberately keep their original value, matching NCCL's
+undefined-on-non-root contract the notebook shows.""")
+    red = jax.jit(C.smap(
+        lambda x: jnp.where(C.axis_rank("dev") == 0,
+                            C.all_reduce(x[0], "dev"), x[0])[None],
+        mesh, in_specs=P("dev"), out_specs=P("dev")))
+    out = red(base)
+    print("after reduce(dst=0) (device 0 has the sum, rest unchanged):")
+    tinfo("t", out)
+    results["reduce"] = np.asarray(out)
+
+    # §7 — gathers (nb cells 40-41) --------------------------------------
+    _banner(
+        "§7  Gathering: all_gather (nb cells 40-41)",
+        """
+torch shows two flavors — a list of tensors and `all_gather_into_tensor`.
+XLA only has the tensor form (`lax.all_gather`, tiled): every device ends
+holding the (n, 3) concatenation.  Watch the sharding: input is sharded
+across devices, output is fully replicated.""")
+    print("before (each device: its own [r, r+1, r+2]):")
+    tinfo("t", base)
+    viz(base)
+    gat = jax.jit(C.smap(lambda x: C.all_gather(x[0][None], "dev"),
+                         mesh, in_specs=P("dev"), out_specs=P()))
+    gathered = gat(base)
+    print("after all_gather (every device holds all rows):")
+    viz(gathered)
+    print(f"  value:\n{np.asarray(gathered)}")
+    results["all_gather"] = np.asarray(gathered)
+
+    # §8 — beyond the notebook: the TPU course's next stops --------------
+    _banner(
+        "§8  Bonus: reduce_scatter / all_to_all / barrier",
+        """
+The notebook stops at gathers; the strategies built on top of it do not.
+ZeRO-2's grad sharding is `reduce_scatter` (zero2.py:107 twin), expert /
+sequence parallelism is `all_to_all`, and `dist.barrier` is — as the
+reference's README.md:11 observes from its own traces — just a 1-element
+all_reduce.""")
+    rs = jax.jit(C.smap(lambda x: C.reduce_scatter(x, "dev")[None],
+                        mesh, in_specs=P(), out_specs=P("dev")))
+    # Every device contributes the same vector [0..n); the sum is n*i at
+    # position i, and reduce_scatter leaves device i holding position i.
+    contrib = jax.device_put(np.arange(n, dtype=np.float32), repl)
+    out = rs(contrib)
+    print(f"  reduce_scatter(rows 0..{n - 1} summed over {n} devices) -> "
+          f"device i keeps {n}*i:")
+    tinfo("shard", out)
+    results["reduce_scatter"] = np.asarray(out)
+
+    a2a = jax.jit(C.smap(
+        lambda x: C.all_to_all(x[0], "dev", split_axis=0, concat_axis=0)[None],
+        mesh, in_specs=P("dev"), out_specs=P("dev")))
+    grid = jax.device_put(
+        np.arange(n * n, dtype=np.float32).reshape(n, n), shard)
+    print("\n  all_to_all on an (n, n) grid — the distributed transpose:")
+    tinfo("before (device i: row i)", grid, values=False)
+    t_grid = a2a(grid)
+    print(f"  after: device i holds column i -> "
+          f"{np.asarray(t_grid)[0].tolist()} on device 0")
+    results["all_to_all"] = np.asarray(t_grid)
+
+    bar = jax.jit(C.smap(lambda: C.barrier("dev")[None],
+                         mesh, in_specs=(), out_specs=P("dev")))
+    tok = jax.block_until_ready(bar())
+    print(f"\n  barrier() -> psum of 1 over {n} devices = "
+          f"{float(np.asarray(tok)[0])} (== world size; "
+          f"block_until_ready gives the host-side fence)")
+    results["barrier"] = np.asarray(tok)
+
+    _banner("§9  Shutdown — the %dist_shutdown twin (nb cell 42)",
+            "Nothing to tear down: no worker processes were started.")
+    return results
+
+
+if __name__ == "__main__":
+    main()

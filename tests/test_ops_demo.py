@@ -1,0 +1,52 @@
+"""The 02-operations teaching twin must execute top-to-bottom on the
+CPU-sim mesh (VERDICT item 6 / SURVEY §2.6) AND teach the right semantics —
+each section's returned value is checked against the collective it claims
+to demonstrate (reference ``02-operations.ipynb`` cells 3-42)."""
+
+import numpy as np
+
+
+def test_ops_demo_runs_and_is_correct(capsys):
+    import scripts.ops_demo as demo
+
+    r = demo.main()
+    out = capsys.readouterr().out
+    n = 8
+
+    # §1 send/recv ring: device i ends with device (i-1)'s payload
+    expect = np.repeat(np.arange(n, dtype=np.float32), 3).reshape(n, 3)
+    assert np.array_equal(r["ppermute"], np.roll(expect, 1, axis=0))
+    # §2 second hop: shifted once more
+    assert np.array_equal(r["async"], np.roll(expect, 2, axis=0))
+    # §3 broadcast: every device holds root's row [1,2,3]
+    assert np.array_equal(r["broadcast"], np.tile([1.0, 2.0, 3.0], (n, 1)))
+    # §4 scatter: device i gets chunk [2i, 2i+1]
+    assert np.array_equal(r["scatter"],
+                          np.arange(2 * n, dtype=np.int32).reshape(n, 2))
+    # §5 reductions of rows [r, r+1, r+2]
+    rows = np.arange(n)[:, None] + np.arange(3)
+    assert np.array_equal(r["all_reduce_sum"],
+                          np.tile(rows.sum(0), (n, 1)))
+    assert np.array_equal(r["all_reduce_max"], np.tile(rows.max(0), (n, 1)))
+    assert np.array_equal(r["all_reduce_min"], np.tile(rows.min(0), (n, 1)))
+    assert np.allclose(r["all_reduce_prod"],
+                       np.tile(rows.prod(0).astype(np.float32), (n, 1)))
+    # §6 reduce(dst=0): root has the sum, others keep their original row
+    assert np.array_equal(r["reduce"][0], rows.sum(0))
+    assert np.array_equal(r["reduce"][1:], rows[1:])
+    # §7 all_gather: replicated full matrix
+    assert np.array_equal(r["all_gather"], rows)
+    # §8 reduce_scatter of replicated arange: device i keeps n*i
+    assert np.array_equal(r["reduce_scatter"].ravel(),
+                          n * np.arange(n, dtype=np.float32))
+    # §8 all_to_all: the distributed transpose
+    grid = np.arange(n * n, dtype=np.float32).reshape(n, n)
+    assert np.array_equal(r["all_to_all"], grid.T)
+    # §8 barrier: psum of ones == world size
+    assert r["barrier"].ravel().tolist() == [float(n)] * n
+
+    # The teaching artifact itself: every notebook section appears, with
+    # sharding visualizations rendered.
+    for sec in range(10):
+        assert f"§{sec}" in out
+    assert "CPU 0" in out  # visualize_array_sharding actually drew a layout
