@@ -82,12 +82,14 @@ def make_classification_examples(vocab_size: int, *, n_examples: int = 2048,
                     f"{vocab_size}; use a matching tokenizer or "
                     f"source='synthetic'")
             return examples
-        except ValueError:
-            raise
         except Exception as e:
+            # "auto" is best-effort by contract: ANY unusable-MRPC condition
+            # (offline, download error, or tokenizer ids exceeding a small
+            # model's vocab) falls back, loudly.  Explicit source="mrpc"
+            # propagates the error instead.
             if source == "mrpc":
                 raise
-            print(f"[data] GLUE MRPC unavailable ({type(e).__name__}: {e}); "
+            print(f"[data] GLUE MRPC unusable ({type(e).__name__}: {e}); "
                   f"falling back to synthetic pairs", flush=True)
     return synthetic_pair_examples(n_examples, vocab_size, seed)
 

@@ -31,7 +31,8 @@ from dataclasses import dataclass
 
 _COMM = re.compile(
     r"(all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter|all[-_]?to[-_]?all"
-    r"|collective[-_]?permute|psum|ppermute|rendezvous|send|recv)",
+    r"|collective[-_]?permute|psum|ppermute|rendezvous(?![ -_]?callback)"
+    r"|send|recv|megacore[-_]?fusion[-_]?wait)",
     re.IGNORECASE)
 _COMPUTE = re.compile(
     r"(^dot|\bdot\b|fusion|convolution|cumsum|reduce|transpose|copy|scatter"
@@ -95,10 +96,13 @@ def split_from_trace(trace_dir: str, top_n: int = 5) -> CommSplit | None:
             continue
         name = e.get("name", "")
         dur = float(e.get("dur", 0.0))
-        if _IGNORE.search(name):
-            continue
+        # Comm first: collective stall events ("megacore-fusion-wait",
+        # "Rendezvous") must win over _IGNORE's generic host-wait patterns
+        # (the docstring's methodology note depends on it).
         if _COMM.search(name):
             comm[name] = comm.get(name, 0.0) + dur
+        elif _IGNORE.search(name):
+            continue
         elif _COMPUTE.search(name):
             compute[name] = compute.get(name, 0.0) + dur
         else:

@@ -43,48 +43,50 @@ def precision_tables(rows: list[dict]) -> str:
         return "_no precision summaries found_\n"
     models = sorted({r["model"] for r in rows})
     seqs = sorted({r["sequence_length"] for r in rows})
+    devs = sorted({r["num_devices"] for r in rows})
     precisions = list(dict.fromkeys(r["precision"] for r in rows))
-    by = {(r["model"], r["precision"], r["sequence_length"]): r
-          for r in rows}
+    by = {(r["model"], r["precision"], r["sequence_length"],
+           r["num_devices"]): r for r in rows}
     out = []
     for metric, fmt, title in (
             ("tokens_per_second", "{:.0f}", "tokens/sec"),
             ("tflops_per_device", "{:.2f}", "TFLOPS/device"),
     ):
         out.append(f"### {title}\n")
-        header = "| model | seq | " + " | ".join(precisions) \
+        header = "| model | seq | devices | " + " | ".join(precisions) \
             + " | best int8 vs bf16 |"
-        out += [header, "|" + "---|" * (len(precisions) + 3)]
+        out += [header, "|" + "---|" * (len(precisions) + 4)]
         for m in models:
             for s in seqs:
-                cells = [m, str(s)]
-                vals = {}
-                for p in precisions:
-                    r = by.get((m, p, s))
-                    vals[p] = r[metric] if r else None
-                    cells.append(fmt.format(r[metric]) if r else "—")
-                ints = [v for k, v in vals.items()
-                        if k != "bf16" and v is not None]
-                if vals.get("bf16") and ints:
-                    cells.append(f"{max(ints) / vals['bf16']:+.1%}"
-                                 .replace("+", "+" if max(ints) >= vals["bf16"]
-                                          else ""))
-                else:
-                    cells.append("—")
-                out.append("| " + " | ".join(cells) + " |")
+                for d in devs:
+                    vals = {p: by.get((m, p, s, d)) for p in precisions}
+                    if not any(vals.values()):
+                        continue
+                    cells = [m, str(s), str(d)]
+                    cells += [fmt.format(vals[p][metric]) if vals[p] else "—"
+                              for p in precisions]
+                    ints = [vals[p][metric] for p in precisions
+                            if p != "bf16" and vals[p]]
+                    if vals.get("bf16") and ints:
+                        speedup = max(ints) / vals["bf16"][metric] - 1.0
+                        cells.append(f"{speedup:+.1%}")
+                    else:
+                        cells.append("—")
+                    out.append("| " + " | ".join(cells) + " |")
         out.append("")
     out.append("### peak memory (model + optimizer, MB per device)\n")
-    out += ["| model | seq | precision | model MB | optimizer MB |",
-            "|---|---|---|---|---|"]
+    out += ["| model | seq | devices | precision | model MB | optimizer MB |",
+            "|---|---|---|---|---|---|"]
     for m in models:
         for s in seqs:
-            for p in precisions:
-                r = by.get((m, p, s))
-                if r:
-                    pm = r.get("peak_memory", {})
-                    out.append(f"| {m} | {s} | {p} | "
-                               f"{pm.get('model_mb', 0):.0f} | "
-                               f"{pm.get('optimizer_mb', 0):.0f} |")
+            for d in devs:
+                for p in precisions:
+                    r = by.get((m, p, s, d))
+                    if r:
+                        pm = r.get("peak_memory", {})
+                        out.append(f"| {m} | {s} | {d} | {p} | "
+                                   f"{pm.get('model_mb', 0):.0f} | "
+                                   f"{pm.get('optimizer_mb', 0):.0f} |")
     out.append("")
     return "\n".join(out)
 

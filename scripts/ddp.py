@@ -42,6 +42,10 @@ def main(argv=None):
                    default="mlp",
                    help="mlp = toy regression; otherwise the MRPC-style "
                         "classification path on that transformer config")
+    p.add_argument("--source", choices=["auto", "mrpc", "synthetic"],
+                   default="auto",
+                   help="classification data: real GLUE MRPC, synthetic "
+                        "pairs, or auto (mrpc with loud synthetic fallback)")
     args, rest = p.parse_known_args(argv)
 
     if args.cpu_devices:
@@ -171,7 +175,8 @@ def classification_main(args, rest):
     assert err == 0.0, f"params diverged across replicas: {err}"
     print(f"[ddp] param sync check passed (divergence {err})")
 
-    examples = make_classification_examples(mcfg.vocab_size)
+    examples = make_classification_examples(mcfg.vocab_size,
+                                            source=args.source)
     print(f"[ddp] dataset: {len(examples)} examples "
           f"(per-rank contiguous shards, pad-to-multiple-of-8 collate)")
 
