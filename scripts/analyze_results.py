@@ -67,7 +67,7 @@ def precision_tables(rows: list[dict]) -> str:
                               for p in precisions]
                     ints = [vals[p][metric] for p in precisions
                             if p != "bf16" and vals[p]]
-                    if vals.get("bf16") and ints:
+                    if vals.get("bf16") and vals["bf16"][metric] and ints:
                         speedup = max(ints) / vals["bf16"][metric] - 1.0
                         cells.append(f"{speedup:+.1%}")
                     else:
