@@ -60,7 +60,11 @@ class TransformerConfig:
     # trade the reference's reshard_after_forward comments gesture at
     # (fsdp/train_fsdp.py:84-88), applied to FLOPs instead of gathers.
     remat_policy: str = "full"  # "full" | "save_attn"
-    attention_impl: str = "xla"  # "xla" | "flash"
+    # "ring" = exact causal attention over a sequence-sharded mesh axis
+    # (``sp_axis``) — context parallelism for sequences past one chip's
+    # HBM; only valid inside shard_map (see parallel/sequence.py).
+    attention_impl: str = "xla"  # "xla" | "flash" | "ring"
+    sp_axis: str | None = None  # mesh axis the sequence is sharded on
     # Cross-entropy vocab chunk: None materializes full (B, S, vocab) fp32
     # logits (the reference's documented ~4 GB spikes, README.md:28-33);
     # an int streams the vocab through an online logsumexp in chunks of
@@ -159,10 +163,13 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (x * w.astype(jnp.float32)).astype(dt)
 
 
-def _rope_tables(seq_len: int, head_dim: int, theta: float):
+def _rope_tables(seq_len: int, head_dim: int, theta: float, offset=0):
+    """``offset`` (may be traced) shifts positions — under sequence
+    parallelism each device's chunk starts at rank · S_local."""
     inv_freq = 1.0 / theta ** (jnp.arange(0, head_dim, 2,
                                           dtype=jnp.float32) / head_dim)
-    ang = jnp.arange(seq_len, dtype=jnp.float32)[:, None] * inv_freq[None, :]
+    pos = offset + jnp.arange(seq_len, dtype=jnp.float32)
+    ang = pos[:, None] * inv_freq[None, :]
     return jnp.cos(ang), jnp.sin(ang)
 
 
@@ -257,6 +264,14 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):
     scale = 1.0 / math.sqrt(hd)
     if cfg.attention_impl == "flash":
         attn = _attention_flash(q, k, v, scale).astype(x.dtype)
+    elif cfg.attention_impl == "ring":
+        if cfg.sp_axis is None:
+            raise ValueError(
+                "attention_impl='ring' needs cfg.sp_axis set to the mesh "
+                "axis the sequence is sharded on, and must run inside "
+                "shard_map (see parallel.sequence.sp_config)")
+        from ..ops.ring_attention import ring_attention
+        attn = ring_attention(q, k, v, cfg.sp_axis, scale=scale)
     else:
         attn = _attention_xla(q, k, v, scale).astype(x.dtype)
     from jax.ad_checkpoint import checkpoint_name
@@ -300,7 +315,11 @@ def hidden_states(params: dict, input_ids: jax.Array,
     """Trunk only: (B, S) ids → final-norm hidden states (B, S, H)."""
     B, S = input_ids.shape
     x = params["embed"].astype(cfg.dtype)[input_ids]
-    cos, sin = _rope_tables(S, cfg.resolved_head_dim, cfg.rope_theta)
+    # Under sequence parallelism S is the LOCAL chunk; RoPE positions and
+    # the causal structure use the global position offset of this rank.
+    offset = lax.axis_index(cfg.sp_axis) * S if cfg.sp_axis else 0
+    cos, sin = _rope_tables(S, cfg.resolved_head_dim, cfg.rope_theta,
+                            offset)
     flags = _rope_flags(cfg)
 
     def body(carry, scanned):

@@ -1,0 +1,102 @@
+"""Ring attention: exact causal attention over a sequence-sharded axis.
+
+Long-context / context-parallel support (SURVEY.md §5.7 notes the reference
+has none — sequence length there is scaled only by seq-len sweeps; this is
+the capability that lets the TPU build go past a single chip's HBM).  The
+idea (Liu et al., "Ring Attention with Blockwise Transformers", 2023; see
+PAPERS.md): shard the sequence across a mesh axis, keep queries resident,
+and circulate K/V blocks around the ring with ``ppermute`` while each
+device folds every visiting block into a flash-style online-softmax
+accumulator.  No device ever holds more than one remote KV block, so
+attention memory is O(S_local · S_block) instead of O(S²), and each hop's
+transfer overlaps the previous block's compute on ICI.
+
+Semantics are EXACT full causal attention over the global sequence —
+verified against the monolithic fp32 reference in tests — not an
+approximation.  Numerics: scores and the (m, l, o) accumulator run in
+fp32 regardless of input dtype (the same policy as ``_attention_xla``).
+
+Causal note: with naive contiguous sharding, later ranks do more useful
+work per hop than earlier ranks (rank 0 masks everything but its own
+block).  The program is SPMD so the wall-clock cost is the full ring
+either way; zigzag/striped layouts that rebalance this are a known
+refinement and deliberately out of scope here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    """(B, Sq, n, hd) × (B, Skv, n, hd) → fp32 (B, n, Sq, Skv)."""
+    return jnp.einsum("bqnh,bknh->bnqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def ring_attention(q, k, v, axis_name: str, *, scale: float,
+                   causal: bool = True) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name`` (shard_map only).
+
+    q, k, v: (B, S_local, n_heads, head_dim) — this device's contiguous
+    chunk of the global sequence, chunks laid out in rank order.  GQA
+    inputs (n_kv < n_q) are repeated up front.  Returns (B, S_local,
+    n_heads, head_dim) in q's dtype.
+    """
+    n_dev = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Sq, nq, hd = q.shape
+    nkv = k.shape[2]
+    rep = nq // nkv  # GQA: repeat per-block at compute time — the ring
+    qf = q.astype(jnp.float32)  # carries (and ships) only the nkv heads
+
+    # Ring: device i sends to i+1, so after t hops we hold block (my - t).
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    tri = jnp.tril(jnp.ones((Sq, Sq), jnp.bool_))
+
+    def fold_block(src, k_blk, v_blk, m, l, o):
+        """Online-softmax merge of one visiting KV block into (m, l, o)."""
+        k_blk = k_blk.astype(jnp.float32)
+        v_blk = v_blk.astype(jnp.float32)
+        if rep != 1:
+            k_blk = jnp.repeat(k_blk, rep, axis=2)
+            v_blk = jnp.repeat(v_blk, rep, axis=2)
+        s = _block_scores(qf, k_blk, scale)
+        if causal:
+            # Global causality across contiguous blocks: earlier block ->
+            # fully visible, own block -> lower triangle, later -> nothing.
+            blk = jnp.where(src == my, tri, src < my)
+            s = jnp.where(blk[None, None], s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)            # (B,n,Sq,1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)
+        # A fully-masked block (src > my) must contribute zero even though
+        # exp(-inf - -inf) would be 1 when m_new is still -inf.
+        p = jnp.where(m_new <= _NEG_INF, 0.0, p)
+        corr = jnp.where(m <= _NEG_INF, 0.0, jnp.exp(m - m_new))
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr.swapaxes(1, 2) + jnp.einsum("bnqk,bknh->bqnh", p, v_blk)
+        return m_new, l, o
+
+    def fold(carry, t):
+        # Permute at iteration START: n_dev-1 hops total, no dead final
+        # transfer (the local block is folded outside the scan).
+        k_blk, v_blk, m, l, o = carry
+        k_blk, v_blk = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk))
+        m, l, o = fold_block((my - t) % n_dev, k_blk, v_blk, m, l, o)
+        return (k_blk, v_blk, m, l, o), None
+
+    m0 = jnp.full((B, nq, Sq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, nq, Sq, 1), jnp.float32)
+    o0 = jnp.zeros((B, Sq, nq, hd), jnp.float32)
+    m, l, o = fold_block(my, k, v, m0, l0, o0)          # t = 0: own block
+    if n_dev > 1:
+        (_, _, _, l, o), _ = lax.scan(fold, (k, v, m, l, o),
+                                      jnp.arange(1, n_dev))
+    l = jnp.where(l == 0.0, 1.0, l)  # rows with no visible keys (unused)
+    return (o / l.swapaxes(1, 2)).astype(q.dtype)

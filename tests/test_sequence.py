@@ -1,0 +1,145 @@
+"""Sequence/context parallelism: ring attention + the FSDP×SP train step.
+
+The reference has no sequence parallelism (SURVEY.md §5.7) — these tests
+pin the TPU build's long-context capability: exact parity of the ring
+against monolithic causal attention, global RoPE positions under sequence
+sharding, and a full 2-D-mesh (dp×sp) training step matching the
+unsharded baseline.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_training_sandbox_tpu.models import transformer as T
+from distributed_training_sandbox_tpu.ops import count_collectives, smap
+from distributed_training_sandbox_tpu.ops.ring_attention import ring_attention
+from distributed_training_sandbox_tpu.parallel import optim, sequence
+from distributed_training_sandbox_tpu.parallel.fsdp import (
+    init_fsdp_opt_state, shard_params_fsdp)
+
+
+@pytest.fixture(scope="module")
+def mesh_dp_sp():
+    from jax.sharding import Mesh
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "sp"))
+
+
+def _qkv(key, B, S, nq, nkv, hd, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (jax.random.normal(kq, (B, S, nq, hd), dtype),
+            jax.random.normal(kk, (B, S, nkv, hd), dtype),
+            jax.random.normal(kv, (B, S, nkv, hd), dtype))
+
+
+@pytest.mark.parametrize("nq,nkv", [(4, 4), (4, 2)])
+def test_ring_attention_matches_monolithic(mesh8, nq, nkv):
+    B, S, hd = 2, 256, 16
+    q, k, v = _qkv(jax.random.PRNGKey(0), B, S, nq, nkv, hd)
+    scale = 1.0 / np.sqrt(hd)
+    ref = T._attention_xla(q, k, v, scale)
+
+    ring = jax.jit(smap(
+        lambda q, k, v: ring_attention(q, k, v, "dp", scale=scale),
+        mesh8, in_specs=P(None, "dp"), out_specs=P(None, "dp")))
+    out = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_noncausal(mesh8):
+    B, S, n, hd = 1, 128, 2, 8
+    q, k, v = _qkv(jax.random.PRNGKey(1), B, S, n, n, hd)
+    scale = 1.0 / np.sqrt(hd)
+    scores = jnp.einsum("bqnh,bknh->bnqk", q, k) * scale
+    ref = jnp.einsum("bnqk,bknh->bqnh", jax.nn.softmax(scores, -1), v)
+    ring = jax.jit(smap(
+        lambda q, k, v: ring_attention(q, k, v, "dp", scale=scale,
+                                       causal=False),
+        mesh8, in_specs=P(None, "dp"), out_specs=P(None, "dp")))
+    np.testing.assert_allclose(np.asarray(ring(q, k, v)), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sp_forward_matches_single_device(mesh8):
+    """Full model forward under sequence sharding == monolithic forward:
+    pins the global RoPE offset and ring causality end-to-end."""
+    cfg = T.TINY_LM
+    key = jax.random.PRNGKey(2)
+    params = T.init_params(key, cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 128), 0,
+                             cfg.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+    base = float(T.lm_loss(params, (ids, labels), cfg))
+
+    rcfg = sequence.sp_config(cfg, "dp")
+    sp_loss = jax.jit(smap(
+        lambda p, b: jax.lax.pmean(T.lm_loss(p, b, rcfg), "dp"),
+        mesh8, in_specs=(P(), P(None, "dp")), out_specs=P()))
+    got = float(sp_loss(params, (ids, labels)))
+    assert abs(got - base) < 2e-4, (got, base)
+
+
+def test_sp_train_step_matches_unsharded_adam(mesh_dp_sp):
+    """3 steps of the dp×sp step track the unsharded jit Adam baseline —
+    the same A/B-in-one-process validation the reference uses for its
+    sharded optimizers (SURVEY.md §4)."""
+    cfg = dataclasses.replace(T.TINY_LM, num_hidden_layers=2)
+    params = T.init_params(jax.random.PRNGKey(4), cfg)
+    B, S = 4, 64
+    ids = jax.random.randint(jax.random.PRNGKey(5), (B, S), 0,
+                             cfg.vocab_size)
+    batch = (ids, jnp.roll(ids, -1, axis=1))
+
+    # unsharded baseline
+    def base_step(p, st, b):
+        loss, g = jax.value_and_grad(lambda p: T.lm_loss(p, b, cfg))(p)
+        # same hyperparams make_sp_train_step defaults to
+        p, st = optim.adam_update(g, st, p, lr=3e-4, b1=0.9, b2=0.95,
+                                  eps=1e-8)
+        return p, st, loss
+
+    bp = params
+    bst = optim.AdamState(mu=jax.tree.map(jnp.zeros_like, params),
+                          nu=jax.tree.map(jnp.zeros_like, params),
+                          count=jnp.zeros((), jnp.int32))
+    base_losses = []
+    jbase = jax.jit(base_step)
+    for _ in range(3):
+        bp, bst, l = jbase(bp, bst, batch)
+        base_losses.append(float(l))
+
+    shards = shard_params_fsdp(params, mesh_dp_sp, "dp")
+    opt = init_fsdp_opt_state(shards)
+    step = sequence.make_sp_train_step(shards, cfg, mesh_dp_sp, donate=False)
+    sp_losses = []
+    for _ in range(3):
+        shards, opt, l = step(shards, opt, batch)
+        sp_losses.append(float(l))
+
+    np.testing.assert_allclose(sp_losses, base_losses, rtol=1e-4, atol=1e-4)
+    # final params match too (gather shards back)
+    full = jax.tree.map(lambda x: np.asarray(x), shards)
+    ref = jax.tree.map(lambda x: np.asarray(x), bp)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, rtol=2e-3, atol=2e-3), full, ref)
+
+
+def test_sp_step_hlo_has_ring_and_fsdp_collectives(mesh_dp_sp):
+    """The choreography is visible in HLO: collective-permutes from the
+    ring (2 per layer scan: K and V) AND the dp gathers/reduce-scatters
+    from FSDP."""
+    cfg = dataclasses.replace(T.TINY_LM, num_hidden_layers=2)
+    params = T.init_params(jax.random.PRNGKey(6), cfg)
+    shards = shard_params_fsdp(params, mesh_dp_sp, "dp")
+    opt = init_fsdp_opt_state(shards)
+    step = sequence.make_sp_train_step(shards, cfg, mesh_dp_sp, donate=False)
+    ids = jnp.zeros((4, 64), jnp.int32)
+    counts = count_collectives(step, shards, opt, (ids, ids))
+    assert counts["collective_permute"] >= 2, counts
+    assert counts["all_gather"] >= 1, counts
+    assert counts["all_reduce"] >= 1, counts
