@@ -262,6 +262,13 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):
     q = jnp.where(use_rope, apply_rope(q, cos, sin), q)
     k = jnp.where(use_rope, apply_rope(k, cos, sin), k)
     scale = 1.0 / math.sqrt(hd)
+    if cfg.sp_axis is not None and cfg.attention_impl != "ring":
+        raise ValueError(
+            f"cfg.sp_axis={cfg.sp_axis!r} (sequence sharded) but "
+            f"attention_impl={cfg.attention_impl!r} masks causality only "
+            f"within the local chunk — tokens would silently never attend "
+            f"across chunk boundaries.  Use attention_impl='ring' "
+            f"(parallel.sequence.sp_config does both).")
     if cfg.attention_impl == "flash":
         attn = _attention_flash(q, k, v, scale).astype(x.dtype)
     elif cfg.attention_impl == "ring":

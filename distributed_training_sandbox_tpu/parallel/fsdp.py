@@ -31,6 +31,7 @@ All-gathers are then contiguous row gathers, and every hot matmul sees full
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable
 
 import jax
@@ -131,6 +132,7 @@ def make_fsdp_train_step(
     *,
     reshard_after_forward: bool = True,
     quantized_gather: bool = False,
+    sp_axis: str | None = None,
     lr: float = 3e-4,
     b1: float = 0.9,
     b2: float = 0.95,
@@ -145,8 +147,16 @@ def make_fsdp_train_step(
     ``batch`` = (input_ids, labels) sharded on the batch dim (dp).
     ``loss_fn(params, batch, cfg, layer_hook=...)`` defaults to the
     causal-LM loss (models.transformer.lm_loss).
+
+    ``sp_axis`` adds sequence/context parallelism (parallel/sequence.py):
+    the batch's sequence dim shards over that mesh axis, attention runs
+    as the ring (``ops/ring_attention.py``), and the sp-replicated param
+    grads get an explicit mean-psum across the ring.
     """
     ws = int(mesh.shape[axis])
+    if sp_axis is not None:
+        cfg = dataclasses.replace(cfg, attention_impl="ring",
+                                  sp_axis=sp_axis)
     base_loss = loss_fn or T.lm_loss
     specs = fsdp_specs(params_sharded, axis)
     check_divisibility(params_sharded, specs, mesh)
@@ -189,8 +199,17 @@ def make_fsdp_train_step(
                 shards, batch)
         with scope("loss_mean"):
             loss = C.all_reduce(loss, axis, mean=True)
+            if sp_axis is not None:
+                loss = C.all_reduce(loss, sp_axis, mean=True)
         with scope("grad_mean"):
-            grad_shards = jax.tree.map(lambda g: g / ws, grad_shards)
+            # dp contributions were already summed into the shards by the
+            # gathers' AD transposes; finish the mean.  Under SP the
+            # params are replicated across sp_axis, so those grads need
+            # an explicit mean-psum across the ring too.
+            grad_shards = jax.tree.map(
+                (lambda g: C.all_reduce(g, sp_axis, mean=True) / ws)
+                if sp_axis is not None else (lambda g: g / ws),
+                grad_shards)
         with scope("opt_step"):
             shards, opt_state = optim.adam_update(
                 grad_shards, opt_state, shards,
@@ -198,8 +217,9 @@ def make_fsdp_train_step(
         return shards, opt_state, loss
 
     state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
+    batch_spec = P(axis) if sp_axis is None else P(axis, sp_axis)
     sharded = C.smap(step, mesh,
-                     in_specs=(specs, state_specs, P(axis)),
+                     in_specs=(specs, state_specs, batch_spec),
                      out_specs=(specs, state_specs, P()))
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 

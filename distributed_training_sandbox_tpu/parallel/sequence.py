@@ -25,20 +25,21 @@ RoPE positions and causal structure use each rank's global chunk offset
 when ``cfg.sp_axis`` is set).  The loss is a mean over local tokens;
 chunks are equal-sized, so the all-axis mean of means equals the global
 mean.
+
+The actual step builder lives in ``fsdp.make_fsdp_train_step`` (one
+choreography, optional ``sp_axis``) so the FSDP gather logic and its
+knobs (reshard_after_forward, quantized_gather, loss_fn) exist once and
+apply to the SP variant too; this module is the SP-facing surface.
 """
 
 from __future__ import annotations
 
 import dataclasses
 
-import jax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..models import transformer as T
-from ..ops import collectives as C
-from ..utils.profiling import scope
-from . import optim
-from .fsdp import (check_divisibility, fsdp_specs, _gather_leaf, _spec_map)
+from .fsdp import make_fsdp_train_step
 
 
 def sp_config(cfg: T.TransformerConfig, sp_axis: str = "sp"
@@ -54,60 +55,14 @@ def make_sp_train_step(
     *,
     dp_axis: str = "dp",
     sp_axis: str = "sp",
-    lr: float = 3e-4,
-    b1: float = 0.9,
-    b2: float = 0.95,
-    eps: float = 1e-8,
-    donate: bool = True,
+    **kwargs,
 ):
     """Jitted FSDP×SP step:
     ``(param_shards, opt_state, batch) -> (param_shards, opt_state, loss)``
     with ``batch`` = (input_ids, labels), both (B, S_global), sharded
     P(dp, sp).  ``params_sharded`` is the dp-FSDP-sharded tree
-    (``fsdp.shard_params_fsdp`` — sp sees replicas).
+    (``fsdp.shard_params_fsdp`` — sp sees replicas).  Accepts every
+    ``make_fsdp_train_step`` knob (reshard_after_forward, lr, donate, …).
     """
-    cfg = sp_config(cfg, sp_axis)
-    ws_dp = int(mesh.shape[dp_axis])
-    specs = fsdp_specs(params_sharded, dp_axis)
-    check_divisibility(params_sharded, specs, mesh)
-    layer_specs = specs["layers"]
-    hook_specs = jax.tree.map(lambda s: P(*s[1:]), layer_specs,
-                              is_leaf=lambda x: isinstance(x, P))
-
-    def layer_hook(layer):
-        with scope("fsdp_layer_gather"):
-            return _spec_map(lambda x, s: _gather_leaf(x, s, dp_axis),
-                             layer, hook_specs)
-
-    def step(shards, opt_state, batch):
-        def sharded_loss(shards, batch):
-            with scope("fsdp_root_gather"):
-                outer = {k: _gather_leaf(v, specs[k], dp_axis)
-                         for k, v in shards.items() if k != "layers"}
-            params = {**outer, "layers": shards["layers"]}
-            return T.lm_loss(params, batch, cfg, layer_hook=layer_hook)
-
-        with scope("forward_backward"):
-            loss, grad_shards = jax.value_and_grad(sharded_loss)(
-                shards, batch)
-        with scope("loss_mean"):
-            loss = C.all_reduce(C.all_reduce(loss, dp_axis, mean=True),
-                                sp_axis, mean=True)
-        with scope("grad_sync"):
-            # dp: the gather transposes already psum_scattered; finish the
-            # mean.  sp: params are replicated, so the shard grads need an
-            # explicit mean-psum across the ring.
-            grad_shards = jax.tree.map(
-                lambda g: C.all_reduce(g, sp_axis, mean=True) / ws_dp,
-                grad_shards)
-        with scope("opt_step"):
-            shards, opt_state = optim.adam_update(
-                grad_shards, opt_state, shards,
-                lr=lr, b1=b1, b2=b2, eps=eps)
-        return shards, opt_state, loss
-
-    state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
-    sharded = C.smap(step, mesh,
-                     in_specs=(specs, state_specs, P(dp_axis, sp_axis)),
-                     out_specs=(specs, state_specs, P()))
-    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+    return make_fsdp_train_step(params_sharded, cfg, mesh, axis=dp_axis,
+                                sp_axis=sp_axis, **kwargs)
