@@ -78,6 +78,21 @@ class TransformerConfig:
     matmul_precision: str = "bf16"
     gated_mlp: bool = True  # duck-types as FlopsConfig for utils.flops
 
+    def __post_init__(self):
+        # Covers every construction path incl. dataclasses.replace: a
+        # sequence-sharded config with a local-chunk attention impl would
+        # silently never attend across chunk boundaries.
+        if self.sp_axis is not None and self.attention_impl != "ring":
+            raise ValueError(
+                f"sp_axis={self.sp_axis!r} (sequence sharded) requires "
+                f"attention_impl='ring', got {self.attention_impl!r} "
+                f"(parallel.sequence.sp_config sets both)")
+        if self.attention_impl == "ring" and self.sp_axis is None:
+            raise ValueError(
+                "attention_impl='ring' needs sp_axis set to the mesh axis "
+                "the sequence is sharded on, and must run inside shard_map "
+                "(see parallel.sequence.sp_config)")
+
     @property
     def resolved_head_dim(self) -> int:
         return self.head_dim or self.hidden_size // self.num_attention_heads
@@ -262,21 +277,9 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):
     q = jnp.where(use_rope, apply_rope(q, cos, sin), q)
     k = jnp.where(use_rope, apply_rope(k, cos, sin), k)
     scale = 1.0 / math.sqrt(hd)
-    if cfg.sp_axis is not None and cfg.attention_impl != "ring":
-        raise ValueError(
-            f"cfg.sp_axis={cfg.sp_axis!r} (sequence sharded) but "
-            f"attention_impl={cfg.attention_impl!r} masks causality only "
-            f"within the local chunk — tokens would silently never attend "
-            f"across chunk boundaries.  Use attention_impl='ring' "
-            f"(parallel.sequence.sp_config does both).")
     if cfg.attention_impl == "flash":
         attn = _attention_flash(q, k, v, scale).astype(x.dtype)
-    elif cfg.attention_impl == "ring":
-        if cfg.sp_axis is None:
-            raise ValueError(
-                "attention_impl='ring' needs cfg.sp_axis set to the mesh "
-                "axis the sequence is sharded on, and must run inside "
-                "shard_map (see parallel.sequence.sp_config)")
+    elif cfg.attention_impl == "ring":  # sp_axis validated in __post_init__
         from ..ops.ring_attention import ring_attention
         attn = ring_attention(q, k, v, cfg.sp_axis, scale=scale)
     else:
@@ -303,7 +306,7 @@ def _rope_flags(cfg: TransformerConfig) -> jax.Array:
 # ---------------------------------------------------------------- forward
 
 def forward(params: dict, input_ids: jax.Array, cfg: TransformerConfig,
-            *, layer_hook=None) -> jax.Array:
+            *, layer_hook=None, layer_body=None) -> jax.Array:
     """``input_ids`` (B, S) int32 → logits (B, S, vocab) in cfg.dtype.
 
     ``layer_hook(layer_params) -> layer_params`` runs inside the scan body
@@ -312,15 +315,23 @@ def forward(params: dict, input_ids: jax.Array, cfg: TransformerConfig,
     forward-pre hooks, ``zero/zero3.py:56-77``).  Because the scan body is
     rematerialized, the hook (and its all_gather) re-runs in the backward
     pass, reproducing the backward pre-hook re-gather.
+
+    ``layer_body`` replaces the decoder-layer computation itself (same
+    signature as ``_layer_body``) — the seam where tensor parallelism
+    substitutes its Megatron-sharded layer (``parallel/tensor.py``) while
+    reusing this scaffold (RoPE tables, NoPE flags, remat, scan, loss).
     """
-    x = hidden_states(params, input_ids, cfg, layer_hook=layer_hook)
+    x = hidden_states(params, input_ids, cfg, layer_hook=layer_hook,
+                      layer_body=layer_body)
     return x @ _output_embedding(params, cfg).T
 
 
 def hidden_states(params: dict, input_ids: jax.Array,
-                  cfg: TransformerConfig, *, layer_hook=None) -> jax.Array:
+                  cfg: TransformerConfig, *, layer_hook=None,
+                  layer_body=None) -> jax.Array:
     """Trunk only: (B, S) ids → final-norm hidden states (B, S, H)."""
     B, S = input_ids.shape
+    apply_layer = layer_body or _layer_body
     x = params["embed"].astype(cfg.dtype)[input_ids]
     # Under sequence parallelism S is the LOCAL chunk; RoPE positions and
     # the causal structure use the global position offset of this rank.
@@ -333,7 +344,7 @@ def hidden_states(params: dict, input_ids: jax.Array,
         layer, use_rope = scanned
         if layer_hook is not None:
             layer = layer_hook(layer)
-        return _layer_body(carry, layer, cfg=cfg, cos=cos, sin=sin,
+        return apply_layer(carry, layer, cfg=cfg, cos=cos, sin=sin,
                            use_rope=use_rope), None
 
     if cfg.remat:
@@ -394,7 +405,7 @@ def chunked_softmax_xent(x: jax.Array, w_vocab: jax.Array,
 
 
 def lm_loss(params: dict, batch, cfg: TransformerConfig,
-            *, layer_hook=None) -> jax.Array:
+            *, layer_hook=None, layer_body=None) -> jax.Array:
     """Causal-LM cross-entropy.  ``batch`` = (input_ids, labels) both (B, S),
     the packed-window contract of the reference's TinyStories pipeline
     (``fsdp/utils.py:58-89``: inputs = window[:-1], labels = window[1:]).
@@ -406,10 +417,12 @@ def lm_loss(params: dict, batch, cfg: TransformerConfig,
     """
     input_ids, labels = batch
     if cfg.loss_vocab_chunk:
-        x = hidden_states(params, input_ids, cfg, layer_hook=layer_hook)
+        x = hidden_states(params, input_ids, cfg, layer_hook=layer_hook,
+                          layer_body=layer_body)
         return chunked_softmax_xent(x, _output_embedding(params, cfg),
                                     labels, cfg.loss_vocab_chunk)
-    logits = forward(params, input_ids, cfg, layer_hook=layer_hook)
+    logits = forward(params, input_ids, cfg, layer_hook=layer_hook,
+                     layer_body=layer_body)
     logits = logits.astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None],

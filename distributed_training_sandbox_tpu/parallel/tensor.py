@@ -1,0 +1,181 @@
+"""Tensor parallelism: Megatron-style sharded transformer layers.
+
+The reference's course outline names TP ("Week 4: Tensor Parallelism from
+scratch") but never implements it (SURVEY.md §2.2: ABSENT) — on TPU it is
+a natural named-mesh-axis extension and the second axis of this build's
+2-D/3-D scaling story (dp × tp, dp × sp).
+
+Layout over the ``tp`` axis (the classic column-then-row pairing):
+
+  * attention: wq/wk/wv shard their OUTPUT dim — each device owns
+    ``num_heads / tp`` query heads (and the matching share of KV heads;
+    GQA group structure is preserved because nq and nkv divide evenly);
+    attention itself is embarrassingly parallel over heads; wo shards its
+    INPUT dim, so each device's contribution is a partial sum → one
+    ``psum`` rejoins the residual stream.
+  * MLP: w_gate/w_up shard the intermediate dim (column), w_down shards
+    its input dim (row) → one ``psum``.
+  * norms, embedding, unembedding: replicated (their grads are mean-psum'd
+    across ``tp`` at step time).
+
+Two psums per layer per direction — the canonical Megatron choreography,
+visible and countable in the HLO like every other strategy here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import transformer as T
+from ..ops import collectives as C
+from ..utils.profiling import scope
+from . import optim
+
+
+def check_tp_divisibility(cfg: T.TransformerConfig, tp: int) -> None:
+    bad = [(n, v) for n, v in (
+        ("num_attention_heads", cfg.num_attention_heads),
+        ("num_key_value_heads", cfg.num_key_value_heads),
+        ("intermediate_size", cfg.intermediate_size)) if v % tp]
+    if bad:
+        raise ValueError(f"tp={tp} must divide " + ", ".join(
+            f"{n}={v}" for n, v in bad))
+
+
+def tp_specs(params, axis: str = "tp") -> dict:
+    """PartitionSpec tree for Megatron sharding.  Stacked layer leaves are
+    (L, in, out): column-parallel ones shard dim 2, row-parallel ones
+    (wo, w_down) shard dim 1; everything else is replicated."""
+    row = {"wo", "w_down"}
+    col = {"wq", "wk", "wv", "w_gate", "w_up"}
+
+    def leaf_spec(path, leaf):
+        name = next((getattr(k, "key", None) for k in reversed(path)
+                     if getattr(k, "key", None)), None)
+        if name in col:
+            return P(None, None, axis)
+        if name in row:
+            return P(None, axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+def shard_params_tp(params, mesh: Mesh, axis: str = "tp"):
+    specs = tp_specs(params, axis)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def _tp_layer_body(x, layer, *, cfg: T.TransformerConfig, cos, sin,
+                   use_rope, axis: str):
+    """One decoder layer on LOCAL head/intermediate shards; two psums
+    rejoin the residual stream (Megatron f/g operators).  Slots into
+    ``models.transformer.hidden_states`` via its ``layer_body`` seam, so
+    the RoPE/NoPE/remat/scan/loss scaffold exists once."""
+    B, S, h = x.shape
+    hd = cfg.resolved_head_dim
+    tp = lax.axis_size(axis)
+    nq, nkv = cfg.num_attention_heads // tp, cfg.num_key_value_heads // tp
+    dense = T._dense(cfg)
+
+    r = T.rms_norm(x, layer["ln1"], cfg.rms_norm_eps)
+    q = dense(r, layer["wq"]).reshape(B, S, nq, hd)
+    k = dense(r, layer["wk"]).reshape(B, S, nkv, hd)
+    v = dense(r, layer["wv"]).reshape(B, S, nkv, hd)
+    q = jnp.where(use_rope, T.apply_rope(q, cos, sin), q)
+    k = jnp.where(use_rope, T.apply_rope(k, cos, sin), k)
+    scale = 1.0 / (hd ** 0.5)
+    if cfg.attention_impl == "flash":
+        attn = T._attention_flash(q, k, v, scale).astype(x.dtype)
+    else:
+        attn = T._attention_xla(q, k, v, scale).astype(x.dtype)
+    from jax.ad_checkpoint import checkpoint_name
+    attn = checkpoint_name(attn, "attn_out")
+    with scope("tp_attn_psum"):
+        x = x + C.all_reduce(dense(attn.reshape(B, S, nq * hd),
+                                   layer["wo"]), axis)
+
+    r = T.rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
+    mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
+                * dense(r, layer["w_up"]), layer["w_down"])
+    with scope("tp_mlp_psum"):
+        return x + C.all_reduce(mlp, axis)
+
+
+def tp_lm_loss(params, batch, cfg: T.TransformerConfig, *,
+               axis: str = "tp") -> jax.Array:
+    """Causal-LM loss with TP layers (shard_map only).  ``params`` hold
+    LOCAL shards; embedding/norms/loss are replicated and identical on
+    every tp rank."""
+    if cfg.attention_impl == "ring":
+        raise ValueError("tensor parallelism does not compose with "
+                         "ring attention / sp_axis yet")
+    import functools
+    return T.lm_loss(params, batch, cfg, layer_body=functools.partial(
+        _tp_layer_body, axis=axis))
+
+
+def make_tp_train_step(
+    params_sharded,
+    cfg: T.TransformerConfig,
+    mesh: Mesh,
+    *,
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+    lr: float = 3e-4,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    eps: float = 1e-8,
+    donate: bool = True,
+    loss_fn: Callable | None = None,
+):
+    """Jitted dp×tp step:
+    ``(param_shards, opt_state, batch) -> (param_shards, opt_state, loss)``.
+    Batch (input_ids, labels) sharded P(dp); params tp-sharded per
+    ``tp_specs`` and replicated over dp (grads mean-psum'd over every
+    axis each leaf is replicated on)."""
+    ws_dp = int(mesh.shape[dp_axis])
+    ws_tp = int(mesh.shape[tp_axis])
+    check_tp_divisibility(cfg, ws_tp)
+    n_total = ws_dp * ws_tp
+    base_loss = loss_fn or tp_lm_loss
+    specs = tp_specs(params_sharded, tp_axis)
+
+    def sync_grad(g, spec):
+        # Sum the copies over every axis this leaf is replicated on (one
+        # fused psum over the combined group), then normalize by total
+        # device count: grads of the global-mean loss.
+        axes = (dp_axis,) if tp_axis in spec else (dp_axis, tp_axis)
+        return lax.psum(g, axes) / n_total
+
+    def step(shards, opt_state, batch):
+        with scope("forward_backward"):
+            loss, grads = jax.value_and_grad(
+                lambda p: base_loss(p, batch, cfg, axis=tp_axis))(shards)
+        with scope("loss_mean"):
+            # tp ranks hold identical losses; the tp-mean re-establishes
+            # replication for the P() out_spec explicitly.
+            loss = C.all_reduce(C.all_reduce(loss, dp_axis, mean=True),
+                                tp_axis, mean=True)
+        with scope("grad_sync"):
+            grads = jax.tree.map(
+                sync_grad, grads, specs,
+                is_leaf=lambda x: isinstance(x, P))
+        with scope("opt_step"):
+            shards, opt_state = optim.adam_update(
+                grads, opt_state, shards, lr=lr, b1=b1, b2=b2, eps=eps)
+        return shards, opt_state, loss
+
+    state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
+    sharded = C.smap(step, mesh,
+                     in_specs=(specs, state_specs, P(dp_axis)),
+                     out_specs=(specs, state_specs, P()))
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())

@@ -129,16 +129,14 @@ def test_sp_train_step_matches_unsharded_adam(mesh_dp_sp):
         a, b, rtol=2e-3, atol=2e-3), full, ref)
 
 
-def test_sp_axis_without_ring_raises(mesh8):
-    """sp_axis set with a local-chunk attention impl would silently drop
-    cross-chunk attention — must fail loudly at trace time."""
-    cfg = dataclasses.replace(T.TINY_LM, sp_axis="dp")  # impl stays "xla"
-    params = T.init_params(jax.random.PRNGKey(7), cfg)
-    ids = jnp.zeros((2, 64), jnp.int32)
-    f = smap(lambda p, b: T.lm_loss(p, b, cfg),
-             mesh8, in_specs=(P(), P(None, "dp")), out_specs=P())
+def test_inconsistent_sp_config_raises():
+    """sp_axis with a local-chunk attention impl would silently drop
+    cross-chunk attention — must fail loudly at config construction
+    (covers every path incl. dataclasses.replace)."""
     with pytest.raises(ValueError, match="ring"):
-        jax.jit(f).lower(params, (ids, ids))
+        dataclasses.replace(T.TINY_LM, sp_axis="sp")  # impl stays "xla"
+    with pytest.raises(ValueError, match="sp_axis"):
+        dataclasses.replace(T.TINY_LM, attention_impl="ring")  # no axis
 
 
 def test_sp_step_hlo_has_ring_and_fsdp_collectives(mesh_dp_sp):

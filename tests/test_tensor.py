@@ -1,0 +1,98 @@
+"""Tensor parallelism: Megatron-sharded layers vs the monolithic model.
+
+TP is absent from the reference (course outline only, SURVEY.md §2.2) —
+these tests pin the TPU build's extension: loss parity of the sharded
+forward, a dp×tp training trajectory against the unsharded baseline, the
+2-psums-per-layer choreography in HLO, and the divisibility contract.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_training_sandbox_tpu.models import transformer as T
+from distributed_training_sandbox_tpu.ops import count_collectives, smap
+from distributed_training_sandbox_tpu.parallel import optim, tensor
+from distributed_training_sandbox_tpu.parallel.fsdp import init_fsdp_opt_state
+
+
+@pytest.fixture(scope="module")
+def mesh_dp_tp():
+    # TINY_LM: 4 q heads / 2 kv heads -> tp=2
+    return Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+
+
+def _data(cfg, B=4, S=64, seed=5):
+    ids = jax.random.randint(jax.random.PRNGKey(seed), (B, S), 0,
+                             cfg.vocab_size)
+    return (ids, jnp.roll(ids, -1, axis=1))
+
+
+def test_tp_loss_matches_monolithic(mesh_dp_tp):
+    cfg = T.TINY_LM
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    batch = _data(cfg)
+    base = float(T.lm_loss(params, batch, cfg))
+
+    specs = tensor.tp_specs(params)
+    f = jax.jit(smap(
+        lambda p, b: jax.lax.pmean(jax.lax.pmean(
+            tensor.tp_lm_loss(p, b, cfg), "tp"), "dp"),
+        mesh_dp_tp, in_specs=(specs, P("dp")), out_specs=P()))
+    got = float(f(tensor.shard_params_tp(params, mesh_dp_tp), batch))
+    assert abs(got - base) < 2e-4, (got, base)
+
+
+def test_tp_train_step_matches_unsharded_adam(mesh_dp_tp):
+    cfg = dataclasses.replace(T.TINY_LM, num_hidden_layers=2)
+    params = T.init_params(jax.random.PRNGKey(1), cfg)
+    batch = _data(cfg, seed=6)
+
+    def base_step(p, st, b):
+        loss, g = jax.value_and_grad(lambda p: T.lm_loss(p, b, cfg))(p)
+        p, st = optim.adam_update(g, st, p, lr=3e-4, b1=0.9, b2=0.95,
+                                  eps=1e-8)
+        return p, st, loss
+
+    bp, bst = params, optim.AdamState(
+        mu=jax.tree.map(jnp.zeros_like, params),
+        nu=jax.tree.map(jnp.zeros_like, params),
+        count=jnp.zeros((), jnp.int32))
+    jbase, base_losses = jax.jit(base_step), []
+    for _ in range(3):
+        bp, bst, l = jbase(bp, bst, batch)
+        base_losses.append(float(l))
+
+    shards = tensor.shard_params_tp(params, mesh_dp_tp)
+    opt = init_fsdp_opt_state(shards)
+    step = tensor.make_tp_train_step(shards, cfg, mesh_dp_tp, donate=False)
+    tp_losses = []
+    for _ in range(3):
+        shards, opt, l = step(shards, opt, batch)
+        tp_losses.append(float(l))
+
+    np.testing.assert_allclose(tp_losses, base_losses, rtol=1e-4, atol=1e-4)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3), shards, bp)
+
+
+def test_tp_step_hlo_psums(mesh_dp_tp):
+    """The Megatron choreography is countable: >= 2 all_reduces per layer
+    (attn + mlp rejoin), plus loss/grad syncs."""
+    cfg = dataclasses.replace(T.TINY_LM, num_hidden_layers=2)
+    params = T.init_params(jax.random.PRNGKey(2), cfg)
+    shards = tensor.shard_params_tp(params, mesh_dp_tp)
+    opt = init_fsdp_opt_state(shards)
+    step = tensor.make_tp_train_step(shards, cfg, mesh_dp_tp, donate=False)
+    ids = jnp.zeros((4, 64), jnp.int32)
+    counts = count_collectives(step, shards, opt, (ids, ids))
+    assert counts["all_reduce"] >= 3, counts
+
+
+def test_tp_divisibility_contract():
+    with pytest.raises(ValueError, match="tp=3"):
+        tensor.check_tp_divisibility(T.TINY_LM, 3)
