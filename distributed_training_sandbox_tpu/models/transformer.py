@@ -262,12 +262,20 @@ def _dense(cfg: TransformerConfig):
     return lambda a, w: Q.quantized_dense(a, w, impl, interp, quantize_bwd)
 
 
-def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):
+def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
+                tp_axis: str | None = None):
     """One decoder layer.  ``layer`` holds this layer's (unstacked) params;
-    ``use_rope`` is a traced bool scalar (NoPE schedule)."""
+    ``use_rope`` is a traced bool scalar (NoPE schedule).
+
+    ``tp_axis``: Megatron tensor parallelism (parallel/tensor.py) — the
+    layer weights are LOCAL shards (wq/wk/wv/w_gate/w_up column-sharded,
+    wo/w_down row-sharded over that mesh axis) and the two row-parallel
+    outputs are psum'd back into the residual stream."""
     B, S, h = x.shape
     hd = cfg.resolved_head_dim
-    nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    tp = lax.axis_size(tp_axis) if tp_axis else 1
+    nq = cfg.num_attention_heads // tp
+    nkv = cfg.num_key_value_heads // tp
     dense = _dense(cfg)
 
     r = rms_norm(x, layer["ln1"], cfg.rms_norm_eps)
@@ -286,11 +294,16 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope):
         attn = _attention_xla(q, k, v, scale).astype(x.dtype)
     from jax.ad_checkpoint import checkpoint_name
     attn = checkpoint_name(attn, "attn_out")
-    x = x + dense(attn.reshape(B, S, nq * hd), layer["wo"])
+    attn_out = dense(attn.reshape(B, S, nq * hd), layer["wo"])
+    if tp_axis:  # Megatron f/g: rejoin the row-parallel partial sums
+        attn_out = lax.psum(attn_out, tp_axis)
+    x = x + attn_out
 
     r = rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
     mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
                 * dense(r, layer["w_up"]), layer["w_down"])
+    if tp_axis:
+        mlp = lax.psum(mlp, tp_axis)
     return x + mlp
 
 

@@ -3,6 +3,7 @@ from . import zero  # noqa: F401
 from . import fsdp  # noqa: F401
 from . import sequence  # noqa: F401
 from . import tensor  # noqa: F401
+from . import expert  # noqa: F401
 from .ddp import (  # noqa: F401
     sync_gradients,
     broadcast_params,

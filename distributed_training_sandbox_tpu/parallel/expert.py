@@ -1,0 +1,214 @@
+"""Expert parallelism: switch-style MoE with ``all_to_all`` dispatch.
+
+The reference records MoE/EP only as a learning note on how expert
+parallelism folds into the mesh (``README.md:13-14`` — SURVEY.md §2.2:
+absent as code).  On TPU it is the canonical use of ``lax.all_to_all``
+(the collective the reference's course stops short of): experts shard
+across the ``ep`` mesh axis, every device routes its tokens, and two
+all_to_alls per layer move token buckets to their experts' devices and
+back.
+
+Mechanics (Switch Transformer, top-1, fixed capacity):
+
+  * router: logits = x @ w_router, expert = argmax, gate = softmax prob
+    of the chosen expert
+  * capacity C per expert bucket; tokens overflowing their bucket are
+    dropped (output 0 for them — the standard switch trade)
+  * dispatch/combine are one-hot einsums over a (tokens, E, C) tensor —
+    static shapes, MXU-friendly, the idiom XLA pipelines well
+  * device d owns experts [d·E/ep, (d+1)·E/ep): the first all_to_all
+    regroups buckets by owning device, the second returns them
+  * aux load-balance loss: E · Σ_e fraction_e · mean_prob_e (Switch
+    eq. 4), averaged over the ep group
+
+Shapes are per-device inside ``shard_map``; expert weights live ONLY on
+their owner (ep-sharded pytree), router weights are replicated.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import collectives as C
+from ..utils.profiling import scope
+from . import optim
+
+
+class MoEParams(NamedTuple):
+    """Per-device pytree: router replicated, experts ep-sharded dim 0."""
+    w_router: jax.Array   # (H, E)
+    w_gate: jax.Array     # (E_local, H, F)
+    w_up: jax.Array       # (E_local, H, F)
+    w_down: jax.Array     # (E_local, F, H)
+
+
+def init_moe_params(key, *, hidden: int, ffn: int, n_experts: int,
+                    dtype=jnp.float32) -> MoEParams:
+    """Full (unsharded) init — shard with ``shard_moe_params``."""
+    kr, kg, ku, kd = jax.random.split(key, 4)
+    s_in = hidden ** -0.5
+    s_ff = ffn ** -0.5
+    return MoEParams(
+        w_router=(jax.random.normal(kr, (hidden, n_experts), dtype) * s_in),
+        w_gate=(jax.random.normal(kg, (n_experts, hidden, ffn), dtype)
+                * s_in),
+        w_up=(jax.random.normal(ku, (n_experts, hidden, ffn), dtype)
+              * s_in),
+        w_down=(jax.random.normal(kd, (n_experts, ffn, hidden), dtype)
+                * s_ff))
+
+
+def moe_specs(axis: str = "ep") -> MoEParams:
+    return MoEParams(w_router=P(), w_gate=P(axis), w_up=P(axis),
+                     w_down=P(axis))
+
+
+def shard_moe_params(params: MoEParams, mesh: Mesh,
+                     axis: str = "ep") -> MoEParams:
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, moe_specs(axis), is_leaf=lambda x: isinstance(x, P))
+
+
+def _route_top1(x2d, w_router):
+    """(N, H) tokens → (gate (N,), expert (N,), probs (N, E))."""
+    logits = (x2d @ w_router).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    return gate, expert, probs
+
+
+def moe_layer(params: MoEParams, x, axis: str = "ep", *,
+              capacity_factor: float = 2.0):
+    """Apply the expert-parallel MoE MLP to local tokens ``x`` (B, S, H)
+    (shard_map only).  Returns (y, aux_loss)."""
+    ep = lax.axis_size(axis)
+    B, S, H = x.shape
+    N = B * S
+    E = params.w_router.shape[1]
+    E_local = params.w_gate.shape[0]
+    if E_local * ep != E:
+        raise ValueError(f"router knows {E} experts but ep={ep} devices "
+                         f"hold {E_local} each")
+    cap = int(-(-N * capacity_factor // E))
+    x2d = x.reshape(N, H)
+
+    with scope("moe_route"):
+        gate, expert, probs = _route_top1(x2d, params.w_router)
+        # position of each token within its expert's bucket
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)   # (N, E)
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1          # (N, E)
+        kept = (pos < cap) & (onehot > 0)                      # (N, E)
+        # (N, E, C) dispatch mask
+        disp = kept[..., None] & (jax.nn.one_hot(
+            jnp.clip(pos, 0, cap - 1), cap, dtype=jnp.bool_))
+        disp = disp.astype(x.dtype)
+
+    with scope("moe_dispatch"):
+        buckets = jnp.einsum("nec,nh->ech", disp, x2d)         # (E, C, H)
+        # regroup buckets by owning device: (ep, E_local, C, H) split on
+        # the device dim → every device receives its experts' buckets
+        # from the whole group, stacked on a new leading dim.
+        recv = C.all_to_all(
+            buckets.reshape(ep, E_local, cap, H), axis,
+            split_axis=0, concat_axis=0, tiled=False)          # (ep, El, C, H)
+
+    with scope("moe_expert_mlp"):
+        toks = recv.transpose(1, 0, 2, 3).reshape(E_local, ep * cap, H)
+        h_gate = jnp.einsum("eth,ehf->etf", toks, params.w_gate)
+        h_up = jnp.einsum("eth,ehf->etf", toks, params.w_up)
+        out = jnp.einsum("etf,efh->eth", jax.nn.silu(h_gate) * h_up,
+                         params.w_down)                        # (El, ep*C, H)
+
+    with scope("moe_return"):
+        back = out.reshape(E_local, ep, cap, H).transpose(1, 0, 2, 3)
+        ret = C.all_to_all(back, axis, split_axis=0, concat_axis=0,
+                           tiled=False)                        # (ep, El, C, H)
+        ret = ret.reshape(E, cap, H)
+        y2d = jnp.einsum("nec,ech->nh", disp, ret) * gate[:, None]
+
+    with scope("moe_aux_loss"):
+        # Switch load-balance: fraction of tokens per expert × mean router
+        # prob per expert, summed, scaled by E; averaged over the group.
+        frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+        mean_p = jnp.mean(probs, axis=0)
+        aux = E * jnp.sum(C.all_reduce(frac, axis, mean=True)
+                          * C.all_reduce(mean_p, axis, mean=True))
+    return y2d.reshape(B, S, H).astype(x.dtype), aux
+
+
+def moe_reference(params: MoEParams, x, *, capacity_factor: float = 2.0):
+    """Single-device semantics oracle: identical routing/capacity/drop
+    rules computed densely with FULL expert weights (E on dim 0), no
+    collectives.  Tests pin ``moe_layer`` == this on any mesh."""
+    B, S, H = x.shape
+    N = B * S
+    E = params.w_router.shape[1]
+    cap = int(-(-N * capacity_factor // E))
+    x2d = x.reshape(N, H)
+    gate, expert, _ = _route_top1(x2d, params.w_router)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1
+    kept = ((pos < cap) & (onehot > 0)).any(axis=1)
+    h_g = jnp.einsum("nh,nhf->nf", x2d,
+                     params.w_gate[expert])
+    h_u = jnp.einsum("nh,nhf->nf", x2d, params.w_up[expert])
+    out = jnp.einsum("nf,nfh->nh", jax.nn.silu(h_g) * h_u,
+                     params.w_down[expert])
+    y = out * gate[:, None] * kept[:, None]
+    return y.reshape(B, S, H).astype(x.dtype)
+
+
+def make_ep_train_step(
+    params_sharded: MoEParams,
+    mesh: Mesh,
+    *,
+    axis: str = "ep",
+    capacity_factor: float = 2.0,
+    aux_weight: float = 0.01,
+    lr: float = 1e-3,
+    donate: bool = True,
+):
+    """Jitted EP step on the toy MoE regression
+    ``(params, opt, (x, y)) -> (params, opt, loss)``: batch sharded on
+    ``ep`` (each device routes its own tokens), expert grads stay local,
+    router grads mean-psum across the group."""
+    ws = int(mesh.shape[axis])
+    specs = moe_specs(axis)
+
+    def step(p, opt_state, batch):
+        x, y = batch
+
+        def loss_fn(p):
+            out, aux = moe_layer(p, x, axis,
+                                 capacity_factor=capacity_factor)
+            return jnp.mean((out - y) ** 2) + aux_weight * aux
+
+        with scope("forward_backward"):
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+        with scope("loss_mean"):
+            loss = C.all_reduce(loss, axis, mean=True)
+        with scope("grad_sync"):
+            # ep-sharded expert weights: each device owns its experts'
+            # grads outright (tokens from the whole group arrived via
+            # all_to_all, whose transpose already returned their
+            # cotangents).  Replicated router: mean across the group.
+            grads = jax.tree.map(
+                lambda g, s: C.all_reduce(g, axis, mean=True)
+                if axis not in s else g / ws,
+                grads, specs, is_leaf=lambda s: isinstance(s, P))
+        with scope("opt_step"):
+            p, opt_state = optim.adam_update(grads, opt_state, p, lr=lr)
+        return p, opt_state, loss
+
+    state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
+    sharded = C.smap(step, mesh,
+                     in_specs=(specs, state_specs, P(axis)),
+                     out_specs=(specs, state_specs, P()))
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())

@@ -28,7 +28,6 @@ import dataclasses
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -74,53 +73,20 @@ def shard_params_tp(params, mesh: Mesh, axis: str = "tp"):
         params, specs, is_leaf=lambda x: isinstance(x, P))
 
 
-def _tp_layer_body(x, layer, *, cfg: T.TransformerConfig, cos, sin,
-                   use_rope, axis: str):
-    """One decoder layer on LOCAL head/intermediate shards; two psums
-    rejoin the residual stream (Megatron f/g operators).  Slots into
-    ``models.transformer.hidden_states`` via its ``layer_body`` seam, so
-    the RoPE/NoPE/remat/scan/loss scaffold exists once."""
-    B, S, h = x.shape
-    hd = cfg.resolved_head_dim
-    tp = lax.axis_size(axis)
-    nq, nkv = cfg.num_attention_heads // tp, cfg.num_key_value_heads // tp
-    dense = T._dense(cfg)
-
-    r = T.rms_norm(x, layer["ln1"], cfg.rms_norm_eps)
-    q = dense(r, layer["wq"]).reshape(B, S, nq, hd)
-    k = dense(r, layer["wk"]).reshape(B, S, nkv, hd)
-    v = dense(r, layer["wv"]).reshape(B, S, nkv, hd)
-    q = jnp.where(use_rope, T.apply_rope(q, cos, sin), q)
-    k = jnp.where(use_rope, T.apply_rope(k, cos, sin), k)
-    scale = 1.0 / (hd ** 0.5)
-    if cfg.attention_impl == "flash":
-        attn = T._attention_flash(q, k, v, scale).astype(x.dtype)
-    else:
-        attn = T._attention_xla(q, k, v, scale).astype(x.dtype)
-    from jax.ad_checkpoint import checkpoint_name
-    attn = checkpoint_name(attn, "attn_out")
-    with scope("tp_attn_psum"):
-        x = x + C.all_reduce(dense(attn.reshape(B, S, nq * hd),
-                                   layer["wo"]), axis)
-
-    r = T.rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
-    mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
-                * dense(r, layer["w_up"]), layer["w_down"])
-    with scope("tp_mlp_psum"):
-        return x + C.all_reduce(mlp, axis)
-
-
 def tp_lm_loss(params, batch, cfg: T.TransformerConfig, *,
                axis: str = "tp") -> jax.Array:
-    """Causal-LM loss with TP layers (shard_map only).  ``params`` hold
-    LOCAL shards; embedding/norms/loss are replicated and identical on
-    every tp rank."""
+    """Causal-LM loss with Megatron TP layers (shard_map only): the
+    shared decoder body (``transformer._layer_body``) runs with
+    ``tp_axis`` set — local head/intermediate shards, two psums per layer
+    — via the ``layer_body`` seam, so the scaffold AND the layer math
+    exist exactly once.  ``params`` hold LOCAL shards; embedding/norms/
+    loss are replicated and identical on every tp rank."""
     if cfg.attention_impl == "ring":
         raise ValueError("tensor parallelism does not compose with "
                          "ring attention / sp_axis yet")
     import functools
     return T.lm_loss(params, batch, cfg, layer_body=functools.partial(
-        _tp_layer_body, axis=axis))
+        T._layer_body, tp_axis=axis))
 
 
 def make_tp_train_step(
@@ -146,7 +112,10 @@ def make_tp_train_step(
     ws_tp = int(mesh.shape[tp_axis])
     check_tp_divisibility(cfg, ws_tp)
     n_total = ws_dp * ws_tp
-    base_loss = loss_fn or tp_lm_loss
+    # loss_fn contract: (params, batch, cfg) -> scalar, same as fsdp's;
+    # the default binds the tp axis itself.
+    base_loss = loss_fn or (
+        lambda p, b, c: tp_lm_loss(p, b, c, axis=tp_axis))
     specs = tp_specs(params_sharded, tp_axis)
 
     def sync_grad(g, spec):
@@ -159,7 +128,7 @@ def make_tp_train_step(
     def step(shards, opt_state, batch):
         with scope("forward_backward"):
             loss, grads = jax.value_and_grad(
-                lambda p: base_loss(p, batch, cfg, axis=tp_axis))(shards)
+                lambda p: base_loss(p, batch, cfg))(shards)
         with scope("loss_mean"):
             # tp ranks hold identical losses; the tp-mean re-establishes
             # replication for the P() out_spec explicitly.

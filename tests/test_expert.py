@@ -1,0 +1,92 @@
+"""Expert parallelism: routing/dispatch parity, choreography, training.
+
+MoE/EP exists in the reference only as a README learning note (SURVEY.md
+§2.2) — these tests pin the TPU build's implementation: the all_to_all
+dispatch computes exactly what the dense single-device oracle computes
+(same top-1 routing, capacity and drop rules), the choreography is
+countable in HLO, and the EP train step learns while keeping expert
+weights device-local.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_training_sandbox_tpu.ops import count_collectives, smap
+from distributed_training_sandbox_tpu.parallel import expert, optim
+from distributed_training_sandbox_tpu.parallel.fsdp import (
+    init_fsdp_opt_state)
+
+HID, FFN, NEXP = 32, 64, 8
+
+
+@pytest.fixture(scope="module")
+def moe_params():
+    return expert.init_moe_params(jax.random.PRNGKey(0), hidden=HID,
+                                  ffn=FFN, n_experts=NEXP)
+
+
+def _tokens(key, B, S):
+    return jax.random.normal(key, (B, S, HID), jnp.float32)
+
+
+@pytest.mark.parametrize("cap_factor", [8.0, 1.0])
+def test_moe_layer_matches_dense_oracle(mesh8, moe_params, cap_factor):
+    """Sharded == oracle per device chunk, both at no-drop capacity and
+    at tight capacity where the drop rule actually bites."""
+    x = _tokens(jax.random.PRNGKey(1), 8, 16)
+    sharded = jax.jit(smap(
+        lambda p, x: expert.moe_layer(p, x, "dp",
+                                      capacity_factor=cap_factor)[0],
+        mesh8, in_specs=(expert.moe_specs("dp"), P("dp")),
+        out_specs=P("dp")))
+    got = sharded(expert.shard_moe_params(moe_params, mesh8, "dp"), x)
+
+    # oracle: each device routes its own chunk independently
+    chunks = [expert.moe_reference(
+        moe_params, x[i:i + 1], capacity_factor=cap_factor)
+        for i in range(8)]
+    ref = jnp.concatenate(chunks, axis=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_moe_drops_overflow_tokens(moe_params):
+    """At capacity_factor well below 1 some tokens MUST drop to zero."""
+    x = _tokens(jax.random.PRNGKey(2), 1, 64)
+    y = expert.moe_reference(moe_params, x, capacity_factor=0.25)
+    zeros = np.all(np.asarray(y[0]) == 0.0, axis=-1)
+    assert zeros.any(), "expected dropped tokens at capacity_factor=0.25"
+    assert not zeros.all(), "everything dropped — routing broken"
+
+
+def test_ep_step_hlo_has_two_all_to_alls(mesh8, moe_params):
+    shards = expert.shard_moe_params(moe_params, mesh8, "dp")
+    opt = init_fsdp_opt_state(shards)
+    step = expert.make_ep_train_step(shards, mesh8, axis="dp",
+                                     donate=False)
+    x = _tokens(jax.random.PRNGKey(3), 8, 16)
+    counts = count_collectives(step, shards, opt, (x, x))
+    # dispatch + return in forward, plus their AD transposes in backward
+    # (XLA may merge one pair: all_to_all is its own transpose)
+    assert counts["all_to_all"] >= 3, counts
+
+
+def test_ep_training_learns(mesh8, moe_params):
+    """The toy regression objective must actually descend, and expert
+    weights must stay sharded (device-local) across steps."""
+    shards = expert.shard_moe_params(moe_params, mesh8, "dp")
+    opt = init_fsdp_opt_state(shards)
+    step = expert.make_ep_train_step(shards, mesh8, axis="dp",
+                                     donate=False)
+    key = jax.random.PRNGKey(4)
+    x = _tokens(key, 8, 16)
+    y = jnp.tanh(x) * 0.5
+    losses = []
+    for _ in range(30):
+        shards, opt, loss = step(shards, opt, (x, y))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses[::10]
+    assert "dp" in str(shards.w_gate.sharding.spec)
