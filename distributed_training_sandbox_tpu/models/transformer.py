@@ -296,14 +296,18 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     attn = checkpoint_name(attn, "attn_out")
     attn_out = dense(attn.reshape(B, S, nq * hd), layer["wo"])
     if tp_axis:  # Megatron f/g: rejoin the row-parallel partial sums
-        attn_out = lax.psum(attn_out, tp_axis)
+        from ..ops import collectives as C
+        from ..utils.profiling import scope
+        with scope("tp_attn_psum"):
+            attn_out = C.all_reduce(attn_out, tp_axis)
     x = x + attn_out
 
     r = rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
     mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
                 * dense(r, layer["w_up"]), layer["w_down"])
     if tp_axis:
-        mlp = lax.psum(mlp, tp_axis)
+        with scope("tp_mlp_psum"):
+            mlp = C.all_reduce(mlp, tp_axis)
     return x + mlp
 
 

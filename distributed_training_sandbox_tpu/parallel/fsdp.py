@@ -100,9 +100,17 @@ def init_fsdp_opt_state(params_sharded, state_dtype=None):
         dt = state_dtype or p.dtype
         return jnp.zeros(p.shape, dt, device=p.sharding)
 
+    count = jnp.zeros((), jnp.int32)
+    leaf = jax.tree.leaves(params_sharded)[0]
+    if isinstance(getattr(leaf, "sharding", None), NamedSharding):
+        # Commit the step counter replicated on the params' mesh so the
+        # whole state tree lives on ONE device set — required for e.g.
+        # checkpoint restore, which places arrays exactly as templated.
+        count = jax.device_put(count, NamedSharding(leaf.sharding.mesh,
+                                                    P()))
     return optim.AdamState(mu=jax.tree.map(zeros, params_sharded),
                            nu=jax.tree.map(zeros, params_sharded),
-                           count=jnp.zeros((), jnp.int32))
+                           count=count)
 
 
 # ---------------------------------------------------------------- explicit

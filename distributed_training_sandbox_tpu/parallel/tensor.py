@@ -113,9 +113,16 @@ def make_tp_train_step(
     check_tp_divisibility(cfg, ws_tp)
     n_total = ws_dp * ws_tp
     # loss_fn contract: (params, batch, cfg) -> scalar, same as fsdp's;
-    # the default binds the tp axis itself.
-    base_loss = loss_fn or (
-        lambda p, b, c: tp_lm_loss(p, b, c, axis=tp_axis))
+    # a loss that declares an ``axis`` parameter (like tp_lm_loss) gets
+    # the tp axis forwarded.
+    if loss_fn is None:
+        base_loss = lambda p, b, c: tp_lm_loss(p, b, c, axis=tp_axis)
+    else:
+        import inspect
+        if "axis" in inspect.signature(loss_fn).parameters:
+            base_loss = lambda p, b, c: loss_fn(p, b, c, axis=tp_axis)
+        else:
+            base_loss = loss_fn
     specs = tp_specs(params_sharded, tp_axis)
 
     def sync_grad(g, spec):
