@@ -1,0 +1,9 @@
+"""``python -m distributed_training_sandbox_tpu.launch`` → the CLI
+(same entry as the installed ``dts-launch`` script)."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

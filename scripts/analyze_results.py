@@ -26,10 +26,18 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def load_precision(dirname: str) -> list[dict]:
+def _load_json_rows(dirname: str, pattern: str = "*.json") -> list[dict]:
+    """Concatenate row dicts from every matching JSON file (each file may
+    hold a list of rows or a single row object)."""
     rows = []
-    for f in sorted(glob.glob(f"{dirname}/summary_*.json")):
-        rows.extend(json.load(open(f)))
+    for f in sorted(glob.glob(f"{dirname}/{pattern}")):
+        d = json.load(open(f))
+        rows.extend(d if isinstance(d, list) else [d])
+    return rows
+
+
+def load_precision(dirname: str) -> list[dict]:
+    rows = _load_json_rows(dirname, "summary_*.json")
     # last write wins per (model, precision, seq, devices) key
     dedup = {}
     for r in rows:
@@ -91,12 +99,31 @@ def precision_tables(rows: list[dict]) -> str:
     return "\n".join(out)
 
 
+def load_longctx(dirname: str) -> list[dict]:
+    return _load_json_rows(dirname)
+
+
+def longctx_table(rows: list[dict]) -> str:
+    if not rows:
+        return "_no long-context sweep found_\n"
+    out = ["| model | seq | tok/s | step ms | TFLOPS/device | note |",
+           "|---|---|---|---|---|---|"]
+    for r in rows:
+        note = "; ".join(f"{k}={v}" for k, v in
+                         r.get("config", {}).items()) or ""
+        if "error" in r:
+            out.append(f"| {r['model']} | {r['seq_len']} | — | — | — | "
+                       f"{r['error'][:60]} |")
+        else:
+            out.append(f"| {r['model']} | {r['seq_len']} | "
+                       f"{r['tokens_per_sec']:.0f} | {r['step_ms']:.0f} | "
+                       f"{r['tflops_per_device']:.2f} | {note} |")
+    out.append("")
+    return "\n".join(out)
+
+
 def load_pp(dirname: str) -> list[dict]:
-    rows = []
-    for f in sorted(glob.glob(f"{dirname}/*.json")):
-        d = json.load(open(f))
-        rows.extend(d if isinstance(d, list) else [d])
-    return [r for r in rows if "schedule" in r]
+    return [r for r in _load_json_rows(dirname) if "schedule" in r]
 
 
 def pp_table(rows: list[dict]) -> str:
@@ -118,11 +145,13 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--precision-dir", default="precision_results")
     p.add_argument("--pp-dir", default="pp_results")
+    p.add_argument("--longctx-dir", default="longcontext_results")
     p.add_argument("--out", default="RESULTS.md")
     args = p.parse_args(argv)
 
     prec = load_precision(args.precision_dir)
     pp = load_pp(args.pp_dir)
+    longctx = load_longctx(args.longctx_dir)
     doc = [
         "# Benchmark results",
         "",
@@ -140,10 +169,17 @@ def main(argv=None):
         "## Pipeline schedules (GPipe vs 1F1B)",
         "",
         pp_table(pp),
+        "## Long-context single-chip sweep (`scripts/long_context.py`)",
+        "",
+        "The reference's longest trained sequence is 8192; these rows "
+        "are one-chip training steps of the 3B-geometry flagship "
+        "(splash attention + streamed-vocab loss + full remat).",
+        "",
+        longctx_table(longctx),
     ]
     Path(args.out).write_text("\n".join(doc))
-    print(f"[analyze] {len(prec)} precision rows, {len(pp)} pp rows "
-          f"-> {args.out}")
+    print(f"[analyze] {len(prec)} precision rows, {len(pp)} pp rows, "
+          f"{len(longctx)} long-context rows -> {args.out}")
 
 
 if __name__ == "__main__":
