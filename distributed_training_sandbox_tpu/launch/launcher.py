@@ -57,6 +57,9 @@ STRATEGY_SCRIPTS = {
     "precision_benchmark": "precision_benchmark.py",
     "busbench": "busbench.py",
 }
+# (ops_demo / long_context / memory_waterline / analyze_results are NOT
+# registered: they don't speak the strategy CLI contract the launcher
+# injects (--num-steps/--cpu-devices) — run them directly.)
 
 _REPO_ROOT = Path(__file__).resolve().parents[2]
 

@@ -106,16 +106,18 @@ def load_longctx(dirname: str) -> list[dict]:
 def longctx_table(rows: list[dict]) -> str:
     if not rows:
         return "_no long-context sweep found_\n"
-    out = ["| model | seq | tok/s | step ms | TFLOPS/device | note |",
-           "|---|---|---|---|---|---|"]
+    out = ["| model | platform | seq | tok/s | step ms | TFLOPS/device "
+           "| note |",
+           "|---|---|---|---|---|---|---|"]
     for r in rows:
         note = "; ".join(f"{k}={v}" for k, v in
                          r.get("config", {}).items()) or ""
+        plat = r.get("platform", "?")
         if "error" in r:
-            out.append(f"| {r['model']} | {r['seq_len']} | — | — | — | "
-                       f"{r['error'][:60]} |")
+            out.append(f"| {r['model']} | {plat} | {r['seq_len']} "
+                       f"| — | — | — | {r['error'][:60]} |")
         else:
-            out.append(f"| {r['model']} | {r['seq_len']} | "
+            out.append(f"| {r['model']} | {plat} | {r['seq_len']} | "
                        f"{r['tokens_per_sec']:.0f} | {r['step_ms']:.0f} | "
                        f"{r['tflops_per_device']:.2f} | {note} |")
     out.append("")

@@ -41,21 +41,17 @@ def main(argv=None):
     for seq in args.seqs:
         # The streamed-loss chunk buffer is B·S·chunk fp32 — at 64k the
         # default 16032-row chunk alone is ~4.2 GB (doesn't fit next to
-        # the activations), so extreme lengths go straight to a narrower
-        # chunk (more scan steps, same math).
-        attempts = [{"loss_vocab_chunk": 4008}] if seq > 32768 else [{}]
-        for over in attempts:
-            try:
-                r = bench.measure(args.model, seq, 1,
-                                  num_steps=args.steps, cfg_overrides=over)
-                rows.append({**r, **({"config": over} if over else {})})
-                break
-            except Exception as e:
-                err = {"model": args.model, "seq_len": seq, "batch": 1,
-                       "config": over,
-                       "error": f"{type(e).__name__}: {str(e)[:160]}"}
-        else:
-            rows.append(err)
+        # the activations), so extreme lengths use a narrower chunk
+        # (more scan steps, same math).
+        over = {"loss_vocab_chunk": 4008} if seq > 32768 else {}
+        try:
+            r = bench.measure(args.model, seq, 1, num_steps=args.steps,
+                              cfg_overrides=over)
+            rows.append({**r, **({"config": over} if over else {})})
+        except Exception as e:
+            rows.append({"model": args.model, "seq_len": seq, "batch": 1,
+                         "config": over,
+                         "error": f"{type(e).__name__}: {str(e)[:160]}"})
         print(f"[longctx] {rows[-1]}", flush=True)
 
     platform = jax.devices()[0].platform
