@@ -60,6 +60,8 @@ KNOB_MATRIX = [
     ("auto", {}, None, 1),                      # None -> pjit-auto variant
     ("explicit_save_attn", {"remat_policy": "save_attn"},
      {"reshard_after_forward": True}, 1),
+    ("explicit_save_dots", {"remat_policy": "save_dots"},
+     {"reshard_after_forward": True}, 1),
     ("explicit_int8_bwd", {"matmul_precision": "int8_bwd"},
      {"reshard_after_forward": True}, 1),
     ("explicit_save_attn_int8", {"remat_policy": "save_attn",

@@ -56,10 +56,14 @@ class TransformerConfig:
     remat: bool = True
     # "full" recomputes the whole layer in backward; "save_attn" keeps each
     # layer's attention output resident (+S·nq·hd bf16 per layer) so the
-    # fused-attention forward doesn't run twice — the rematerialisation
-    # trade the reference's reshard_after_forward comments gesture at
-    # (fsdp/train_fsdp.py:84-88), applied to FLOPs instead of gathers.
-    remat_policy: str = "full"  # "full" | "save_attn"
+    # fused-attention forward doesn't run twice; "save_dots" keeps every
+    # matmul output resident — the backward recomputes only cheap
+    # elementwise ops, trading ~all of remat's extra forward FLOPs for
+    # O(layers · S · (heads+ffn)) activation memory.  The
+    # rematerialisation trade the reference's reshard_after_forward
+    # comments gesture at (fsdp/train_fsdp.py:84-88), applied to FLOPs
+    # instead of gathers.
+    remat_policy: str = "full"  # "full" | "save_attn" | "save_dots"
     # "ring" = exact causal attention over a sequence-sharded mesh axis
     # (``sp_axis``) — context parallelism for sequences past one chip's
     # HBM; only valid inside shard_map (see parallel/sequence.py).
@@ -365,8 +369,13 @@ def hidden_states(params: dict, input_ids: jax.Array,
                            use_rope=use_rope), None
 
     if cfg.remat:
-        policy = (jax.checkpoint_policies.save_only_these_names("attn_out")
-                  if cfg.remat_policy == "save_attn" else None)
+        policy = {
+            "save_attn":
+                jax.checkpoint_policies.save_only_these_names("attn_out"),
+            "save_dots":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "full": None,
+        }[cfg.remat_policy]
         body = jax.checkpoint(body, prevent_cse=False, policy=policy)
     x, _ = lax.scan(body, x, (params["layers"], flags))
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)

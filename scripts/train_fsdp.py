@@ -36,7 +36,8 @@ def main(argv=None):
     p.add_argument("--no-reshard-after-forward", dest="reshard",
                    action="store_false", default=True)
     p.add_argument("--attention", choices=["xla", "flash"], default=None)
-    p.add_argument("--remat-policy", choices=["full", "save_attn"],
+    p.add_argument("--remat-policy",
+                   choices=["full", "save_attn", "save_dots"],
                    default=None)
     args, rest = p.parse_known_args(argv)
 

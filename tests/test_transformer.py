@@ -156,9 +156,10 @@ def test_chunked_softmax_xent_direct():
     assert got == pytest.approx(want, abs=1e-5)
 
 
-def test_save_attn_remat_policy_matches(setup):
+@pytest.mark.parametrize("policy", ["save_attn", "save_dots"])
+def test_remat_policy_matches(setup, policy):
     params, batch = setup
-    cfg_s = dataclasses.replace(CFG, remat=True, remat_policy="save_attn")
+    cfg_s = dataclasses.replace(CFG, remat=True, remat_policy=policy)
     base = float(jax.jit(lambda p, b: T.lm_loss(p, b, CFG))(params, batch))
     saved = float(jax.jit(lambda p, b: T.lm_loss(p, b, cfg_s))(params, batch))
     assert saved == pytest.approx(base, abs=1e-5)
