@@ -56,6 +56,11 @@ STRATEGY_SCRIPTS = {
     "precision": "precision_benchmark.py",
     "precision_benchmark": "precision_benchmark.py",
     "busbench": "busbench.py",
+    "train_sp": "train_sp.py",
+    "sp": "train_sp.py",
+    "train_tp": "train_tp.py",
+    "tp": "train_tp.py",
+    "moe": "moe.py",
 }
 # (ops_demo / long_context / memory_waterline / analyze_results are NOT
 # registered: they don't speak the strategy CLI contract the launcher

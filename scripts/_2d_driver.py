@@ -1,0 +1,139 @@
+"""Shared driver for the 2-D-mesh strategy scripts (train_sp / train_tp).
+
+Same shape as the other L3 drivers (``_zero_driver``, ``train_fsdp``):
+model from config, packed dataset with offline fallback, warmup-aware
+tracker, optional profiler with the comm/compute split, HLO collective
+counts printed up front so the choreography is visible without a trace.
+
+The reference has no 2-D strategies at all — these scripts are the
+runnable surface of the build's extensions (SURVEY.md §2.2 marks TP/SP
+absent): ``train_sp`` = FSDP over ``dp`` × ring-attention sequence
+parallelism over ``sp``; ``train_tp`` = data parallel over ``dp`` ×
+Megatron tensor parallelism over ``tp``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_training_sandbox_tpu.models import MODEL_REGISTRY as MODELS  # noqa: E402
+
+
+def run(mode: str, argv=None):
+    assert mode in ("sp", "tp")
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--model", choices=sorted(MODELS), default="tiny")
+    p.add_argument(f"--{mode}", type=int, default=2, dest="second",
+                   help=f"size of the {mode} mesh axis (dp gets the rest)")
+    args, rest = p.parse_known_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    import jax
+    import jax.numpy as jnp
+    from distributed_training_sandbox_tpu.data import (
+        make_packed_dataset, packed_batches)
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.ops import count_collectives
+    from distributed_training_sandbox_tpu.parallel import (
+        fsdp, sequence, tensor)
+    from distributed_training_sandbox_tpu.utils import (
+        PerformanceTracker, ProfileSchedule, Profiler, TrainConfig,
+        annotate, make_mesh, print_memory_stats, set_seed)
+    from distributed_training_sandbox_tpu.utils.flops import (
+        get_model_flops_per_token)
+
+    cfg = TrainConfig.from_args(
+        rest, sequence_length=256 if args.model == "tiny" else 8192)
+    mcfg: T.TransformerConfig = getattr(T, MODELS[args.model])
+    n_dev = len(jax.devices())
+    second = args.second
+    if second < 1 or n_dev % second:
+        raise SystemExit(f"--{mode} {second} must be >= 1 and divide "
+                         f"device count {n_dev}")
+    mesh = make_mesh({"dp": n_dev // second, mode: second})
+    dp = n_dev // second
+    name = f"train_{mode}"
+
+    if mode == "sp" and cfg.sequence_length % second:
+        raise SystemExit(f"--sequence-length {cfg.sequence_length} must "
+                         f"be divisible by sp={second}")
+    if mode == "tp":
+        tensor.check_tp_divisibility(mcfg, second)
+    if cfg.batch_size % dp:
+        if any(r == "--batch-size" or r.startswith("--batch-size=")
+               for r in rest or []):
+            raise SystemExit(f"--batch-size {cfg.batch_size} must be "
+                             f"divisible by dp={dp}")
+        cfg.batch_size = dp * max(1, cfg.batch_size // dp)
+    print(f"[{name}] model={args.model} ({mcfg.param_count()/1e9:.3f}B) "
+          f"mesh={dict(mesh.shape)} batch={cfg.batch_size} "
+          f"seq={cfg.sequence_length} platform={jax.devices()[0].platform}")
+
+    key = set_seed(cfg.seed)
+    params = T.init_params(key, mcfg)
+    if mode == "sp":
+        shards = fsdp.shard_params_fsdp(params, mesh, "dp")
+        step = sequence.make_sp_train_step(shards, mcfg, mesh)
+    else:
+        shards = tensor.shard_params_tp(params, mesh)
+        step = tensor.make_tp_train_step(shards, mcfg, mesh)
+    del params
+    opt_state = fsdp.init_fsdp_opt_state(shards)
+    print_memory_stats(f"{name}-at-rest", params=shards,
+                       opt_state=opt_state)
+
+    input_ids, labels = make_packed_dataset(
+        cfg.sequence_length, mcfg.vocab_size,
+        num_tokens=max(cfg.batch_size * cfg.num_steps, 8)
+        * (cfg.sequence_length + 1))
+    probe = (jnp.zeros((cfg.batch_size, cfg.sequence_length), jnp.int32),) * 2
+    counts = count_collectives(step, shards, opt_state, probe)
+    expect = ("ppermutes from the KV ring + dp gathers/reduce-scatters"
+              if mode == "sp" else "2 psums/layer + grad syncs")
+    print(f"[{name}] per-step collectives (HLO): {counts} ({expect})")
+
+    flops_tok = get_model_flops_per_token(mcfg, cfg.sequence_length)
+    tracker = PerformanceTracker(
+        warmup_steps=min(3, max(cfg.num_steps - 1, 0)),
+        flops_per_token=flops_tok, num_devices=n_dev)
+    prof = Profiler(trace_dir=cfg.trace_dir,
+                    schedule=ProfileSchedule(skip_first=0, wait=1,
+                                             warmup=2, active=5)) \
+        if cfg.profile else None
+
+    metrics = None
+    batches = packed_batches(input_ids, labels, cfg.batch_size,
+                             epochs=cfg.num_epochs * cfg.num_steps)
+    for i in range(cfg.num_steps):
+        with annotate("data_movement"):
+            bi, bl = next(batches)
+            batch = (jnp.asarray(bi), jnp.asarray(bl))
+        shards, opt_state, loss = step(shards, opt_state, batch)
+        jax.block_until_ready(loss)
+        metrics = tracker.step(cfg.batch_size * cfg.sequence_length,
+                               loss=float(loss))
+        if prof:
+            prof.step()
+        if i % 5 == 0 or i == cfg.num_steps - 1:
+            print(f"[{name}] step {i:3d} loss {float(loss):.4f}")
+    if prof:
+        prof.stop()
+        from distributed_training_sandbox_tpu.utils.trace_analysis import (
+            split_from_trace)
+        sp_ = split_from_trace(cfg.trace_dir)
+        if sp_:
+            print(sp_.report(name))
+
+    if metrics:
+        print(f"[{name}] tokens/s {metrics['tokens_per_second']:.1f} "
+              f"TFLOPS/dev {metrics.get('tflops_per_device', 0):.2f} "
+              f"avg_loss {metrics.get('avg_loss', float('nan')):.4f}")
+    return metrics

@@ -1,0 +1,88 @@
+"""Expert-parallel training: switch-MoE over the ``ep`` axis (the
+reference records MoE/EP only as a README learning note — SURVEY.md
+§2.2; see ``parallel/expert.py``).
+
+Trains the toy MoE regression with all_to_all dispatch, printing the
+per-step HLO collective counts (the two ring hops + router syncs) and
+the load-balance behaviour.
+
+  python scripts/moe.py --cpu-devices 8 --num-steps 30 [--experts 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--experts", type=int, default=0,
+                   help="total experts (default 2 per device)")
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--ffn", type=int, default=128)
+    p.add_argument("--capacity-factor", type=float, default=2.0)
+    args, rest = p.parse_known_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    import jax
+    import jax.numpy as jnp
+    from distributed_training_sandbox_tpu.ops import count_collectives
+    from distributed_training_sandbox_tpu.parallel import expert, fsdp
+    from distributed_training_sandbox_tpu.utils import (
+        TrainConfig, make_mesh, set_seed)
+
+    cfg = TrainConfig.from_args(rest, num_steps=30)
+    n_dev = len(jax.devices())
+    n_exp = args.experts or 2 * n_dev
+    if n_exp % n_dev:
+        raise SystemExit(f"--experts {n_exp} must be divisible by device "
+                         f"count {n_dev}")
+    mesh = make_mesh({"ep": n_dev})
+    print(f"[moe] {n_exp} experts over mesh={dict(mesh.shape)} "
+          f"hidden={args.hidden} ffn={args.ffn} "
+          f"capacity_factor={args.capacity_factor} "
+          f"platform={jax.devices()[0].platform}")
+
+    key = set_seed(cfg.seed)
+    params = expert.shard_moe_params(
+        expert.init_moe_params(key, hidden=args.hidden, ffn=args.ffn,
+                               n_experts=n_exp), mesh)
+    opt = fsdp.init_fsdp_opt_state(params)
+    step = expert.make_ep_train_step(
+        params, mesh, capacity_factor=args.capacity_factor, donate=False)
+
+    B = max(cfg.batch_size, n_dev)
+    if B % n_dev:
+        raise SystemExit(f"--batch-size {B} must be divisible by device "
+                         f"count {n_dev}")
+    kx = jax.random.PRNGKey(cfg.seed + 1)
+    x = jax.random.normal(kx, (B, 16, args.hidden), jnp.float32)
+    y = jnp.tanh(x) * 0.5
+
+    counts = count_collectives(step, params, opt, (x, y))
+    print(f"[moe] per-step collectives (HLO): {counts} "
+          f"(dispatch/return all_to_alls + router grad psum)")
+
+    first = last = None
+    for i in range(cfg.num_steps):
+        params, opt, loss = step(params, opt, (x, y))
+        last = float(loss)
+        first = first if first is not None else last
+        if i % 10 == 0 or i == cfg.num_steps - 1:
+            print(f"[moe] step {i:3d} loss {last:.5f}")
+    if first is not None:
+        print(f"[moe] loss {first:.5f} -> {last:.5f} "
+              f"({'learning' if last < first else 'NOT learning'})")
+    return {"first_loss": first, "final_loss": last}
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Sequence-parallel training: FSDP over ``dp`` × ring attention over
+``sp`` (no reference counterpart — SURVEY.md §5.7; see
+``parallel/sequence.py``).
+
+  python scripts/train_sp.py --cpu-devices 8 --sp 4 --num-steps 10
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from _2d_driver import run  # noqa: E402
+
+if __name__ == "__main__":
+    run("sp")

@@ -1,0 +1,16 @@
+"""Tensor-parallel training: data parallel over ``dp`` × Megatron TP over
+``tp`` (the reference names TP in its course outline but never builds it
+— SURVEY.md §2.2; see ``parallel/tensor.py``).
+
+  python scripts/train_tp.py --cpu-devices 8 --tp 2 --num-steps 10
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from _2d_driver import run  # noqa: E402
+
+if __name__ == "__main__":
+    run("tp")
