@@ -80,10 +80,12 @@ def tp_lm_loss(params, batch, cfg: T.TransformerConfig, *,
     ``tp_axis`` set — local head/intermediate shards, two psums per layer
     — via the ``layer_body`` seam, so the scaffold AND the layer math
     exist exactly once.  ``params`` hold LOCAL shards; embedding/norms/
-    loss are replicated and identical on every tp rank."""
-    if cfg.attention_impl == "ring":
-        raise ValueError("tensor parallelism does not compose with "
-                         "ring attention / sp_axis yet")
+    loss are replicated and identical on every tp rank.
+
+    Composes with sequence parallelism: with ``cfg.sp_axis`` set (ring
+    attention), each device holds its tp-share of heads AND its sp-chunk
+    of the sequence — the KV ring circulates over ``sp_axis`` within
+    each tp group, carrying only the local heads."""
     import functools
     return T.lm_loss(params, batch, cfg, layer_body=functools.partial(
         T._layer_body, tp_axis=axis))
@@ -96,6 +98,7 @@ def make_tp_train_step(
     *,
     dp_axis: str = "dp",
     tp_axis: str = "tp",
+    sp_axis: str | None = None,
     lr: float = 3e-4,
     b1: float = 0.9,
     b2: float = 0.95,
@@ -107,11 +110,28 @@ def make_tp_train_step(
     ``(param_shards, opt_state, batch) -> (param_shards, opt_state, loss)``.
     Batch (input_ids, labels) sharded P(dp); params tp-sharded per
     ``tp_specs`` and replicated over dp (grads mean-psum'd over every
-    axis each leaf is replicated on)."""
+    axis each leaf is replicated on).
+
+    ``sp_axis`` makes it the full 3-D dp×sp×tp step: the batch's
+    sequence dim shards over ``sp_axis`` and attention becomes the KV
+    ring over it (carrying only this device's tp-share of heads)."""
     ws_dp = int(mesh.shape[dp_axis])
     ws_tp = int(mesh.shape[tp_axis])
     check_tp_divisibility(cfg, ws_tp)
+    if sp_axis is None and cfg.sp_axis is not None:
+        raise ValueError(
+            f"cfg.sp_axis={cfg.sp_axis!r} (ring attention) but "
+            f"make_tp_train_step got sp_axis=None — the batch would "
+            f"replicate over {cfg.sp_axis!r} and sp grads would never "
+            f"sync.  Pass sp_axis={cfg.sp_axis!r} (the step sets the "
+            f"ring config itself).")
     n_total = ws_dp * ws_tp
+    rep_axes = [dp_axis]
+    if sp_axis is not None:
+        cfg = dataclasses.replace(cfg, attention_impl="ring",
+                                  sp_axis=sp_axis)
+        n_total *= int(mesh.shape[sp_axis])
+        rep_axes.append(sp_axis)
     # loss_fn contract: (params, batch, cfg) -> scalar, same as fsdp's;
     # a loss that declares an ``axis`` parameter (like tp_lm_loss) gets
     # the tp axis forwarded.
@@ -129,7 +149,8 @@ def make_tp_train_step(
         # Sum the copies over every axis this leaf is replicated on (one
         # fused psum over the combined group), then normalize by total
         # device count: grads of the global-mean loss.
-        axes = (dp_axis,) if tp_axis in spec else (dp_axis, tp_axis)
+        axes = tuple(rep_axes) + ((tp_axis,) if tp_axis not in spec
+                                  else ())
         return lax.psum(g, axes) / n_total
 
     def step(shards, opt_state, batch):
@@ -139,8 +160,8 @@ def make_tp_train_step(
         with scope("loss_mean"):
             # tp ranks hold identical losses; the tp-mean re-establishes
             # replication for the P() out_spec explicitly.
-            loss = C.all_reduce(C.all_reduce(loss, dp_axis, mean=True),
-                                tp_axis, mean=True)
+            for ax in rep_axes + [tp_axis]:
+                loss = C.all_reduce(loss, ax, mean=True)
         with scope("grad_sync"):
             grads = jax.tree.map(
                 sync_grad, grads, specs,
@@ -151,7 +172,8 @@ def make_tp_train_step(
         return shards, opt_state, loss
 
     state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
+    batch_spec = P(dp_axis) if sp_axis is None else P(dp_axis, sp_axis)
     sharded = C.smap(step, mesh,
-                     in_specs=(specs, state_specs, P(dp_axis)),
+                     in_specs=(specs, state_specs, batch_spec),
                      out_specs=(specs, state_specs, P()))
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())

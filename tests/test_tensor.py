@@ -96,3 +96,54 @@ def test_tp_step_hlo_psums(mesh_dp_tp):
 def test_tp_divisibility_contract():
     with pytest.raises(ValueError, match="tp=3"):
         tensor.check_tp_divisibility(T.TINY_LM, 3)
+
+
+def test_ring_config_without_sp_axis_kwarg_raises(mesh_dp_tp):
+    """A pre-made ring config with the sp_axis kwarg forgotten would
+    silently replicate the batch over sp and never sync sp grads —
+    must raise instead."""
+    from distributed_training_sandbox_tpu.parallel import sequence
+    cfg = sequence.sp_config(T.TINY_LM)
+    params = T.init_params(jax.random.PRNGKey(9), cfg)
+    shards = tensor.shard_params_tp(params, mesh_dp_tp)
+    with pytest.raises(ValueError, match="sp_axis"):
+        tensor.make_tp_train_step(shards, cfg, mesh_dp_tp)
+
+
+def test_3d_dp_sp_tp_step_matches_unsharded_adam():
+    """The full 3-D composition — batch over dp, sequence over sp (KV
+    ring with tp-local heads), weights over tp — tracks the unsharded
+    baseline: the capstone of the mesh-axis design."""
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("dp", "sp", "tp"))
+    cfg = dataclasses.replace(T.TINY_LM, num_hidden_layers=2)
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    batch = _data(cfg, B=4, S=64, seed=7)
+
+    def base_step(p, st, b):
+        loss, g = jax.value_and_grad(lambda p: T.lm_loss(p, b, cfg))(p)
+        p, st = optim.adam_update(g, st, p, lr=3e-4, b1=0.9, b2=0.95,
+                                  eps=1e-8)
+        return p, st, loss
+
+    bp = params
+    bst = optim.AdamState(mu=jax.tree.map(jnp.zeros_like, params),
+                          nu=jax.tree.map(jnp.zeros_like, params),
+                          count=jnp.zeros((), jnp.int32))
+    jbase, base_losses = jax.jit(base_step), []
+    for _ in range(3):
+        bp, bst, l = jbase(bp, bst, batch)
+        base_losses.append(float(l))
+
+    shards = tensor.shard_params_tp(params, mesh)
+    opt = init_fsdp_opt_state(shards)
+    step = tensor.make_tp_train_step(shards, cfg, mesh, sp_axis="sp",
+                                     donate=False)
+    losses = []
+    for _ in range(3):
+        shards, opt, l = step(shards, opt, batch)
+        losses.append(float(l))
+
+    np.testing.assert_allclose(losses, base_losses, rtol=1e-4, atol=1e-4)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3), shards, bp)
