@@ -32,15 +32,19 @@ def init_classifier_params(key: jax.Array, cfg: T.TransformerConfig,
 
 def classifier_logits(params: dict, input_ids: jax.Array,
                       attention_mask: jax.Array,
-                      cfg: T.TransformerConfig, *, layer_hook=None):
+                      cfg: T.TransformerConfig, *, layer_hook=None,
+                      return_aux: bool = False):
     """(B, S) ids + 0/1 mask → (B, num_labels) logits: trunk → last-non-pad
-    pool → head."""
-    h = T.hidden_states(params["trunk"], input_ids, cfg,
-                        layer_hook=layer_hook)          # (B, S, H)
+    pool → head.  ``return_aux`` adds the trunk's summed auxiliary loss
+    (MoE load balance; 0 for dense trunks)."""
+    h, aux = T.hidden_states(params["trunk"], input_ids, cfg,
+                             layer_hook=layer_hook,
+                             return_aux=True)           # (B, S, H)
     last = jnp.maximum(jnp.sum(attention_mask, axis=-1) - 1, 0)  # (B,)
     pooled = jnp.take_along_axis(
         h, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]  # (B, H)
-    return pooled @ params["cls_head"].astype(h.dtype)
+    logits = pooled @ params["cls_head"].astype(h.dtype)
+    return (logits, aux) if return_aux else logits
 
 
 def classification_loss(params: dict, batch, cfg: T.TransformerConfig,
@@ -48,13 +52,18 @@ def classification_loss(params: dict, batch, cfg: T.TransformerConfig,
     """Mean softmax cross-entropy.  ``batch`` = dict with ``input_ids``
     (B, S) int32, ``attention_mask`` (B, S) 0/1, ``labels`` (B,) int32 —
     the collate contract of ``data.classification.pad_collate``."""
-    logits = classifier_logits(params, batch["input_ids"],
-                               batch["attention_mask"], cfg,
-                               layer_hook=layer_hook).astype(jnp.float32)
+    logits, aux = classifier_logits(params, batch["input_ids"],
+                                    batch["attention_mask"], cfg,
+                                    layer_hook=layer_hook,
+                                    return_aux=True)
+    logits = logits.astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, batch["labels"][:, None],
                                axis=-1)[:, 0]
-    return jnp.mean(logz - gold)
+    loss = jnp.mean(logz - gold)
+    if cfg.n_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 def classification_accuracy(params: dict, batch,

@@ -81,6 +81,17 @@ class TransformerConfig:
     # "bf16" | "int8" | "int8_pallas" | "int8_bwd" | "int8_pallas_bwd"
     matmul_precision: str = "bf16"
     gated_mlp: bool = True  # duck-types as FlopsConfig for utils.flops
+    # Mixture-of-experts MLP (parallel/expert.py): 0 = dense.  With
+    # n_experts > 0 every layer's MLP becomes a top-1 switch-MoE of
+    # ``n_experts`` experts with ``moe_ffn`` (default intermediate_size)
+    # hidden width; ``ep_axis`` shards experts across that mesh axis
+    # (None = all experts local).  The Switch load-balance aux loss is
+    # summed over layers and added to lm_loss with ``moe_aux_weight``.
+    n_experts: int = 0
+    moe_ffn: int | None = None
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
+    ep_axis: str | None = None
 
     def __post_init__(self):
         # Covers every construction path incl. dataclasses.replace: a
@@ -96,6 +107,12 @@ class TransformerConfig:
                 "attention_impl='ring' needs sp_axis set to the mesh axis "
                 "the sequence is sharded on, and must run inside shard_map "
                 "(see parallel.sequence.sp_config)")
+        if self.n_experts and self.matmul_precision != "bf16":
+            raise ValueError(
+                "quantized matmul_precision is not implemented for the "
+                "MoE expert MLPs — attention would quantize while the "
+                "experts silently wouldn't; use matmul_precision='bf16' "
+                "with n_experts")
 
     @property
     def resolved_head_dim(self) -> int:
@@ -105,7 +122,11 @@ class TransformerConfig:
         h, hd = self.hidden_size, self.resolved_head_dim
         attn = h * hd * (self.num_attention_heads * 2
                          + self.num_key_value_heads * 2)
-        mlp = 3 * h * self.intermediate_size
+        if self.n_experts:
+            F = self.moe_ffn or self.intermediate_size
+            mlp = self.n_experts * 3 * h * F + h * self.n_experts
+        else:
+            mlp = 3 * h * self.intermediate_size
         norms = 2 * h
         per_layer = attn + mlp + norms
         embed = self.vocab_size * h
@@ -162,12 +183,22 @@ def init_params(key: jax.Array, cfg: TransformerConfig) -> dict:
             "wv": tn(next(keys), (L, h, nkv * hd)),
             "wo": tn(next(keys), (L, nq * hd, h), out_std),
             "ln2": jnp.ones((L, h), cfg.dtype),
-            "w_gate": tn(next(keys), (L, h, cfg.intermediate_size)),
-            "w_up": tn(next(keys), (L, h, cfg.intermediate_size)),
-            "w_down": tn(next(keys), (L, cfg.intermediate_size, h), out_std),
         },
         "final_norm": jnp.ones((h,), cfg.dtype),
     }
+    if cfg.n_experts:
+        E, F = cfg.n_experts, cfg.moe_ffn or cfg.intermediate_size
+        params["layers"].update(
+            w_router=tn(next(keys), (L, h, E)),
+            w_gate=tn(next(keys), (L, E, h, F)),
+            w_up=tn(next(keys), (L, E, h, F)),
+            w_down=tn(next(keys), (L, E, F, h), out_std))
+    else:
+        params["layers"].update(
+            w_gate=tn(next(keys), (L, h, cfg.intermediate_size)),
+            w_up=tn(next(keys), (L, h, cfg.intermediate_size)),
+            w_down=tn(next(keys), (L, cfg.intermediate_size, h),
+                      out_std))
     if not cfg.tie_word_embeddings:
         params["lm_head"] = tn(next(keys), (h, cfg.vocab_size))
     return params
@@ -307,12 +338,23 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     x = x + attn_out
 
     r = rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
-    mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
-                * dense(r, layer["w_up"]), layer["w_down"])
-    if tp_axis:
-        with scope("tp_mlp_psum"):
-            mlp = C.all_reduce(mlp, tp_axis)
-    return x + mlp
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.n_experts:
+        if tp_axis:
+            raise ValueError("MoE layers do not compose with tensor "
+                             "parallelism yet (shard experts via ep_axis)")
+        from ..parallel.expert import moe_mlp
+        mlp, aux = moe_mlp(r, layer["w_router"], layer["w_gate"],
+                           layer["w_up"], layer["w_down"],
+                           axis=cfg.ep_axis,
+                           capacity_factor=cfg.moe_capacity_factor)
+    else:
+        mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
+                    * dense(r, layer["w_up"]), layer["w_down"])
+        if tp_axis:
+            with scope("tp_mlp_psum"):
+                mlp = C.all_reduce(mlp, tp_axis)
+    return x + mlp, aux
 
 
 def _rope_flags(cfg: TransformerConfig) -> jax.Array:
@@ -349,8 +391,10 @@ def forward(params: dict, input_ids: jax.Array, cfg: TransformerConfig,
 
 def hidden_states(params: dict, input_ids: jax.Array,
                   cfg: TransformerConfig, *, layer_hook=None,
-                  layer_body=None) -> jax.Array:
-    """Trunk only: (B, S) ids → final-norm hidden states (B, S, H)."""
+                  layer_body=None, return_aux: bool = False):
+    """Trunk only: (B, S) ids → final-norm hidden states (B, S, H).
+    ``return_aux=True`` additionally returns the per-layer auxiliary
+    losses summed (the MoE load-balance term; 0 for dense layers)."""
     B, S = input_ids.shape
     apply_layer = layer_body or _layer_body
     x = params["embed"].astype(cfg.dtype)[input_ids]
@@ -365,8 +409,9 @@ def hidden_states(params: dict, input_ids: jax.Array,
         layer, use_rope = scanned
         if layer_hook is not None:
             layer = layer_hook(layer)
-        return apply_layer(carry, layer, cfg=cfg, cos=cos, sin=sin,
-                           use_rope=use_rope), None
+        x, aux = apply_layer(carry, layer, cfg=cfg, cos=cos, sin=sin,
+                             use_rope=use_rope)
+        return x, aux
 
     if cfg.remat:
         policy = {
@@ -377,8 +422,9 @@ def hidden_states(params: dict, input_ids: jax.Array,
             "full": None,
         }[cfg.remat_policy]
         body = jax.checkpoint(body, prevent_cse=False, policy=policy)
-    x, _ = lax.scan(body, x, (params["layers"], flags))
-    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x, aux = lax.scan(body, x, (params["layers"], flags))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return (x, jnp.sum(aux)) if return_aux else x
 
 
 def _output_embedding(params: dict, cfg: TransformerConfig) -> jax.Array:
@@ -442,18 +488,20 @@ def lm_loss(params: dict, batch, cfg: TransformerConfig,
     vocab instead (see chunked_softmax_xent).
     """
     input_ids, labels = batch
+    x, aux = hidden_states(params, input_ids, cfg, layer_hook=layer_hook,
+                           layer_body=layer_body, return_aux=True)
     if cfg.loss_vocab_chunk:
-        x = hidden_states(params, input_ids, cfg, layer_hook=layer_hook,
-                          layer_body=layer_body)
-        return chunked_softmax_xent(x, _output_embedding(params, cfg),
+        loss = chunked_softmax_xent(x, _output_embedding(params, cfg),
                                     labels, cfg.loss_vocab_chunk)
-    logits = forward(params, input_ids, cfg, layer_hook=layer_hook,
-                     layer_body=layer_body)
-    logits = logits.astype(jnp.float32)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, labels[..., None],
-                               axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    else:
+        logits = (x @ _output_embedding(params, cfg).T).astype(jnp.float32)
+        logz = jax.scipy.special.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None],
+                                   axis=-1)[..., 0]
+        loss = jnp.mean(logz - gold)
+    if cfg.n_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 def model_flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:

@@ -84,15 +84,19 @@ def _route_top1(x2d, w_router):
     return gate, expert, probs
 
 
-def moe_layer(params: MoEParams, x, axis: str = "ep", *,
-              capacity_factor: float = 2.0):
-    """Apply the expert-parallel MoE MLP to local tokens ``x`` (B, S, H)
-    (shard_map only).  Returns (y, aux_loss)."""
-    ep = lax.axis_size(axis)
+def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
+            capacity_factor: float = 2.0):
+    """The switch-MoE MLP on local tokens ``x`` (B, S, H) →
+    ``(y, aux_loss)``.  ``w_gate/w_up/w_down`` hold this device's
+    ``E_local`` experts on dim 0; ``axis=None`` means no expert
+    parallelism (all experts local, no collectives) — the form the
+    MoE transformer uses on a 1-D mesh and the dense oracle of the
+    EP choreography."""
+    ep = lax.axis_size(axis) if axis else 1
     B, S, H = x.shape
     N = B * S
-    E = params.w_router.shape[1]
-    E_local = params.w_gate.shape[0]
+    E = w_router.shape[1]
+    E_local = w_gate.shape[0]
     if E_local * ep != E:
         raise ValueError(f"router knows {E} experts but ep={ep} devices "
                          f"hold {E_local} each")
@@ -100,7 +104,7 @@ def moe_layer(params: MoEParams, x, axis: str = "ep", *,
     x2d = x.reshape(N, H)
 
     with scope("moe_route"):
-        gate, expert, probs = _route_top1(x2d, params.w_router)
+        gate, expert, probs = _route_top1(x2d, w_router)
         # position of each token within its expert's bucket
         onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)   # (N, E)
         pos = jnp.cumsum(onehot, axis=0) * onehot - 1          # (N, E)
@@ -115,22 +119,24 @@ def moe_layer(params: MoEParams, x, axis: str = "ep", *,
         # regroup buckets by owning device: (ep, E_local, C, H) split on
         # the device dim → every device receives its experts' buckets
         # from the whole group, stacked on a new leading dim.
-        recv = C.all_to_all(
-            buckets.reshape(ep, E_local, cap, H), axis,
-            split_axis=0, concat_axis=0, tiled=False)          # (ep, El, C, H)
+        recv = buckets.reshape(ep, E_local, cap, H)
+        if axis:
+            recv = C.all_to_all(recv, axis, split_axis=0, concat_axis=0,
+                                tiled=False)                   # (ep, El, C, H)
 
     with scope("moe_expert_mlp"):
         toks = recv.transpose(1, 0, 2, 3).reshape(E_local, ep * cap, H)
-        h_gate = jnp.einsum("eth,ehf->etf", toks, params.w_gate)
-        h_up = jnp.einsum("eth,ehf->etf", toks, params.w_up)
+        h_gate = jnp.einsum("eth,ehf->etf", toks, w_gate)
+        h_up = jnp.einsum("eth,ehf->etf", toks, w_up)
         out = jnp.einsum("etf,efh->eth", jax.nn.silu(h_gate) * h_up,
-                         params.w_down)                        # (El, ep*C, H)
+                         w_down)                               # (El, ep*C, H)
 
     with scope("moe_return"):
         back = out.reshape(E_local, ep, cap, H).transpose(1, 0, 2, 3)
-        ret = C.all_to_all(back, axis, split_axis=0, concat_axis=0,
-                           tiled=False)                        # (ep, El, C, H)
-        ret = ret.reshape(E, cap, H)
+        if axis:
+            back = C.all_to_all(back, axis, split_axis=0, concat_axis=0,
+                                tiled=False)                   # (ep, El, C, H)
+        ret = back.reshape(E, cap, H)
         y2d = jnp.einsum("nec,ech->nh", disp, ret) * gate[:, None]
 
     with scope("moe_aux_loss"):
@@ -138,9 +144,20 @@ def moe_layer(params: MoEParams, x, axis: str = "ep", *,
         # prob per expert, summed, scaled by E; averaged over the group.
         frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
         mean_p = jnp.mean(probs, axis=0)
-        aux = E * jnp.sum(C.all_reduce(frac, axis, mean=True)
-                          * C.all_reduce(mean_p, axis, mean=True))
+        if axis:
+            frac = C.all_reduce(frac, axis, mean=True)
+            mean_p = C.all_reduce(mean_p, axis, mean=True)
+        aux = E * jnp.sum(frac * mean_p)
     return y2d.reshape(B, S, H).astype(x.dtype), aux
+
+
+def moe_layer(params: MoEParams, x, axis: str = "ep", *,
+              capacity_factor: float = 2.0):
+    """Apply the expert-parallel MoE MLP to local tokens ``x`` (B, S, H)
+    (shard_map only).  Returns (y, aux_loss)."""
+    return moe_mlp(x, params.w_router, params.w_gate, params.w_up,
+                   params.w_down, axis=axis,
+                   capacity_factor=capacity_factor)
 
 
 def moe_reference(params: MoEParams, x, *, capacity_factor: float = 2.0):
@@ -163,6 +180,92 @@ def moe_reference(params: MoEParams, x, *, capacity_factor: float = 2.0):
                      params.w_down[expert])
     y = out * gate[:, None] * kept[:, None]
     return y.reshape(B, S, H).astype(x.dtype)
+
+
+def moe_lm_specs(params, axis: str = "ep") -> dict:
+    """PartitionSpec tree for the MoE transformer: expert-stacked layer
+    leaves (L, E, ...) shard the expert dim over ``axis``; the router and
+    every dense leaf are replicated."""
+    expert_leaves = {"w_gate", "w_up", "w_down"}
+
+    def leaf_spec(path, leaf):
+        name = next((getattr(k, "key", None) for k in reversed(path)
+                     if getattr(k, "key", None)), None)
+        if name in expert_leaves and leaf.ndim == 4:   # (L, E, h/F, F/h)
+            return P(None, axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+def shard_moe_lm_params(params, mesh: Mesh, axis: str = "ep"):
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, moe_lm_specs(params, axis),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_moe_lm_train_step(
+    params_sharded,
+    cfg,
+    mesh: Mesh,
+    *,
+    dp_axis: str = "dp",
+    ep_axis: str = "ep",
+    lr: float = 3e-4,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    eps: float = 1e-8,
+    donate: bool = True,
+):
+    """Jitted dp×ep step for the MoE *transformer*
+    (``models.transformer`` with ``cfg.n_experts > 0``):
+    ``(param_shards, opt_state, batch) -> (param_shards, opt_state, loss)``.
+    Batch (input_ids, labels) sharded over BOTH axes (dp×ep is the data
+    group — every device routes only its own token shard); each layer's
+    MoE MLP all_to_alls tokens to the expert owners across the ep row
+    and back.  Expert grads arrive via the all_to_all transposes (psum
+    over dp only); dense/router grads mean-psum over the whole group."""
+    import dataclasses
+
+    from ..models import transformer as T
+
+    if not cfg.n_experts:
+        raise ValueError("cfg.n_experts must be > 0 for the MoE step")
+    ws_dp = int(mesh.shape[dp_axis])
+    ws_ep = int(mesh.shape[ep_axis])
+    if cfg.n_experts % ws_ep:
+        raise ValueError(f"n_experts={cfg.n_experts} must be divisible "
+                         f"by ep={ws_ep}")
+    cfg = dataclasses.replace(cfg, ep_axis=ep_axis)
+    n_total = ws_dp * ws_ep
+    specs = moe_lm_specs(params_sharded, ep_axis)
+
+    def sync_grad(g, spec):
+        axes = (dp_axis,) if ep_axis in spec else (dp_axis, ep_axis)
+        return jax.lax.psum(g, axes) / n_total
+
+    def step(shards, opt_state, batch):
+        with scope("forward_backward"):
+            loss, grads = jax.value_and_grad(
+                lambda p: T.lm_loss(p, batch, cfg))(shards)
+        with scope("loss_mean"):
+            loss = C.all_reduce(C.all_reduce(loss, dp_axis, mean=True),
+                                ep_axis, mean=True)
+        with scope("grad_sync"):
+            grads = jax.tree.map(sync_grad, grads, specs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        with scope("opt_step"):
+            shards, opt_state = optim.adam_update(
+                grads, opt_state, shards, lr=lr, b1=b1, b2=b2, eps=eps)
+        return shards, opt_state, loss
+
+    state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
+    sharded = C.smap(step, mesh,
+                     in_specs=(specs, state_specs,
+                               P((dp_axis, ep_axis))),
+                     out_specs=(specs, state_specs, P()))
+    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
 
 def make_ep_train_step(

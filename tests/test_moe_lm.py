@@ -1,0 +1,116 @@
+"""MoE transformer: switch-MoE MLPs inside the real LM, trained dp×ep.
+
+The reference's MoE story is one README learning note (SURVEY.md §2.2);
+this build makes it a first-class model option
+(``TransformerConfig.n_experts`` + ``parallel/expert.py``).  Pinned here:
+a single-expert MoE is EXACTLY the dense model, expert-sharded loss
+matches the all-local computation, and the dp×ep training step learns
+with the all_to_all choreography visible in HLO.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_training_sandbox_tpu.models import transformer as T
+from distributed_training_sandbox_tpu.ops import count_collectives, smap
+from distributed_training_sandbox_tpu.parallel import expert
+from distributed_training_sandbox_tpu.parallel.fsdp import (
+    init_fsdp_opt_state)
+
+TINY_MOE = dataclasses.replace(
+    T.TINY_LM, n_experts=8, moe_ffn=64, moe_capacity_factor=4.0)
+
+
+@pytest.fixture(scope="module")
+def mesh_dp_ep():
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "ep"))
+
+
+def _batch(cfg, B=8, S=32, seed=1):
+    ids = jax.random.randint(jax.random.PRNGKey(seed), (B, S), 0,
+                             cfg.vocab_size)
+    return (ids, jnp.roll(ids, -1, axis=1))
+
+
+def test_single_expert_moe_equals_dense():
+    """E=1 with capacity >= tokens reduces the whole MoE machinery to
+    the dense SwiGLU: router prob is exactly 1, nothing drops, dispatch
+    is a permutation — losses must match to numerical noise."""
+    dense_cfg = T.TINY_LM
+    moe_cfg = dataclasses.replace(
+        dense_cfg, n_experts=1, moe_ffn=dense_cfg.intermediate_size,
+        moe_capacity_factor=1.0, moe_aux_weight=0.0)
+    dense = T.init_params(jax.random.PRNGKey(0), dense_cfg)
+    L, h = dense_cfg.num_hidden_layers, dense_cfg.hidden_size
+    moe = dict(dense)
+    moe["layers"] = dict(dense["layers"])
+    moe["layers"]["w_router"] = jnp.zeros((L, h, 1), dense_cfg.dtype)
+    for k in ("w_gate", "w_up", "w_down"):
+        moe["layers"][k] = dense["layers"][k][:, None]  # (L, 1, ., .)
+
+    batch = _batch(dense_cfg)
+    a = float(T.lm_loss(dense, batch, dense_cfg))
+    b = float(T.lm_loss(moe, batch, moe_cfg))
+    assert a == pytest.approx(b, abs=1e-5), (a, b)
+
+
+def test_ep_sharded_moe_loss_matches_local(mesh_dp_ep):
+    """Expert-sharded (all_to_all) forward == all-experts-local forward
+    at no-drop capacity, with the batch sharded dp×ep."""
+    cfg = dataclasses.replace(TINY_MOE, moe_capacity_factor=8.0,
+                              moe_aux_weight=0.0)
+    params = T.init_params(jax.random.PRNGKey(2), cfg)
+    batch = _batch(cfg)
+
+    # local oracle: mean of per-device-chunk losses (equal chunks)
+    local_cfg = dataclasses.replace(cfg, ep_axis=None)
+    chunks = [float(T.lm_loss(params, (batch[0][i:i + 1],
+                                       batch[1][i:i + 1]), local_cfg))
+              for i in range(8)]
+    want = float(np.mean(chunks))
+
+    shards = expert.shard_moe_lm_params(params, mesh_dp_ep)
+    ep_cfg = dataclasses.replace(cfg, ep_axis="ep")
+    specs = expert.moe_lm_specs(params)
+    f = jax.jit(smap(
+        lambda p, b: jax.lax.pmean(jax.lax.pmean(
+            T.lm_loss(p, b, ep_cfg), "ep"), "dp"),
+        mesh_dp_ep, in_specs=(specs, P(("dp", "ep"))), out_specs=P()))
+    got = float(f(shards, batch))
+    assert got == pytest.approx(want, abs=2e-4), (got, want)
+
+
+def test_moe_lm_train_step_learns(mesh_dp_ep):
+    cfg = TINY_MOE
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    shards = expert.shard_moe_lm_params(params, mesh_dp_ep)
+    opt = init_fsdp_opt_state(shards)
+    step = expert.make_moe_lm_train_step(shards, cfg, mesh_dp_ep,
+                                         donate=False)
+    batch = _batch(cfg, seed=4)
+    losses = []
+    s, o = shards, opt
+    for _ in range(12):
+        s, o, loss = step(s, o, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses[::4]
+    # expert weights stayed ep-sharded
+    assert "ep" in str(s["layers"]["w_gate"].sharding.spec)
+
+    counts = count_collectives(step, shards, opt, batch)
+    # layers run under lax.scan, so HLO holds the loop body once:
+    # dispatch + return in the forward body, their transposes in the
+    # backward body (each executed num_hidden_layers times).
+    assert counts["all_to_all"] >= 4, counts
+
+
+def test_moe_step_validates_expert_divisibility(mesh_dp_ep):
+    cfg = dataclasses.replace(TINY_MOE, n_experts=6)  # 6 % 4 != 0
+    params = T.init_params(jax.random.PRNGKey(5), cfg)
+    with pytest.raises(ValueError, match="divisible"):
+        expert.make_moe_lm_train_step(params, cfg, mesh_dp_ep)
