@@ -91,6 +91,7 @@ class TransformerConfig:
     moe_ffn: int | None = None
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 0.01
+    moe_dispatch: str = "sort"  # "sort" (fast) | "einsum" (oracle)
     ep_axis: str | None = None
 
     def __post_init__(self):
@@ -347,7 +348,8 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
         mlp, aux = moe_mlp(r, layer["w_router"], layer["w_gate"],
                            layer["w_up"], layer["w_down"],
                            axis=cfg.ep_axis,
-                           capacity_factor=cfg.moe_capacity_factor)
+                           capacity_factor=cfg.moe_capacity_factor,
+                           dispatch=cfg.moe_dispatch)
     else:
         mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
                     * dense(r, layer["w_up"]), layer["w_down"])

@@ -85,13 +85,23 @@ def _route_top1(x2d, w_router):
 
 
 def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
-            capacity_factor: float = 2.0):
+            capacity_factor: float = 2.0, dispatch: str = "sort"):
     """The switch-MoE MLP on local tokens ``x`` (B, S, H) →
     ``(y, aux_loss)``.  ``w_gate/w_up/w_down`` hold this device's
     ``E_local`` experts on dim 0; ``axis=None`` means no expert
     parallelism (all experts local, no collectives) — the form the
     MoE transformer uses on a 1-D mesh and the dense oracle of the
-    EP choreography."""
+    EP choreography.
+
+    ``dispatch``: how tokens reach their (E, C, H) buckets.
+      * "sort" (default): stable-sort tokens by expert, scatter kept ones
+        into their slots, gather back — O(N·H) data movement.
+      * "einsum": the classic one-hot (N, E, C) dispatch/combine einsums
+        (GShard-style).  Readable and differentiable the same way, but
+        O(N·E·C·H) compute — measured 3× slower end-to-end at B·S=16k,
+        E=8 on v5e.  Kept as the semantics oracle; both paths compute
+        identical outputs (pinned by tests).
+    """
     ep = lax.axis_size(axis) if axis else 1
     B, S, H = x.shape
     N = B * S
@@ -105,17 +115,41 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
 
     with scope("moe_route"):
         gate, expert, probs = _route_top1(x2d, w_router)
-        # position of each token within its expert's bucket
-        onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)   # (N, E)
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1          # (N, E)
-        kept = (pos < cap) & (onehot > 0)                      # (N, E)
-        # (N, E, C) dispatch mask
-        disp = kept[..., None] & (jax.nn.one_hot(
-            jnp.clip(pos, 0, cap - 1), cap, dtype=jnp.bool_))
-        disp = disp.astype(x.dtype)
 
-    with scope("moe_dispatch"):
-        buckets = jnp.einsum("nec,nh->ech", disp, x2d)         # (E, C, H)
+    if dispatch == "einsum":
+        with scope("moe_route_onehot"):
+            # position of each token within its expert's bucket
+            onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # (N, E)
+            pos = jnp.cumsum(onehot, axis=0) * onehot - 1         # (N, E)
+            kept = (pos < cap) & (onehot > 0)                     # (N, E)
+            # (N, E, C) dispatch mask
+            disp = kept[..., None] & (jax.nn.one_hot(
+                jnp.clip(pos, 0, cap - 1), cap, dtype=jnp.bool_))
+            disp = disp.astype(x.dtype)
+        with scope("moe_dispatch"):
+            buckets = jnp.einsum("nec,nh->ech", disp, x2d)       # (E, C, H)
+    elif dispatch == "sort":
+        with scope("moe_dispatch"):
+            # Stable sort groups tokens by expert in original order, so
+            # position-within-group == the cumsum position the drop rule
+            # is defined by.
+            order = jnp.argsort(expert, stable=True)             # (N,)
+            sorted_e = expert[order]
+            counts = jnp.bincount(expert, length=E)
+            starts = jnp.cumsum(counts) - counts                 # exclusive
+            pos = jnp.arange(N) - starts[sorted_e]
+            keep = pos < cap
+            # kept tokens scatter to their slot; dropped ones to a trash
+            # row one past the end.
+            slot = jnp.where(keep, sorted_e * cap + jnp.minimum(pos, cap - 1),
+                             E * cap)
+            buckets = jnp.zeros((E * cap + 1, H), x.dtype
+                                ).at[slot].set(x2d[order])[:-1]
+            buckets = buckets.reshape(E, cap, H)
+    else:
+        raise ValueError(f"unknown dispatch {dispatch!r}")
+
+    with scope("moe_a2a_out"):
         # regroup buckets by owning device: (ep, E_local, C, H) split on
         # the device dim → every device receives its experts' buckets
         # from the whole group, stacked on a new leading dim.
@@ -131,18 +165,29 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
         out = jnp.einsum("etf,efh->eth", jax.nn.silu(h_gate) * h_up,
                          w_down)                               # (El, ep*C, H)
 
-    with scope("moe_return"):
+    with scope("moe_a2a_back"):
         back = out.reshape(E_local, ep, cap, H).transpose(1, 0, 2, 3)
         if axis:
             back = C.all_to_all(back, axis, split_axis=0, concat_axis=0,
                                 tiled=False)                   # (ep, El, C, H)
-        ret = back.reshape(E, cap, H)
-        y2d = jnp.einsum("nec,ech->nh", disp, ret) * gate[:, None]
+        ret = back.reshape(E * cap, H)
+
+    with scope("moe_combine"):
+        if dispatch == "einsum":
+            y2d = jnp.einsum("nec,ech->nh", disp,
+                             ret.reshape(E, cap, H)) * gate[:, None]
+        else:
+            pulled = jnp.concatenate([ret, jnp.zeros((1, H), ret.dtype)])
+            y_sorted = pulled[slot] * keep[:, None].astype(ret.dtype)
+            # O(N) inverse of the sort permutation (not a second sort)
+            inv = jnp.zeros((N,), order.dtype).at[order].set(
+                jnp.arange(N, dtype=order.dtype))
+            y2d = y_sorted[inv] * gate[:, None]
 
     with scope("moe_aux_loss"):
         # Switch load-balance: fraction of tokens per expert × mean router
         # prob per expert, summed, scaled by E; averaged over the group.
-        frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+        frac = (jnp.bincount(expert, length=E) / N).astype(jnp.float32)
         mean_p = jnp.mean(probs, axis=0)
         if axis:
             frac = C.all_reduce(frac, axis, mean=True)
@@ -152,12 +197,12 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
 
 
 def moe_layer(params: MoEParams, x, axis: str = "ep", *,
-              capacity_factor: float = 2.0):
+              capacity_factor: float = 2.0, dispatch: str = "sort"):
     """Apply the expert-parallel MoE MLP to local tokens ``x`` (B, S, H)
     (shard_map only).  Returns (y, aux_loss)."""
     return moe_mlp(x, params.w_router, params.w_gate, params.w_up,
                    params.w_down, axis=axis,
-                   capacity_factor=capacity_factor)
+                   capacity_factor=capacity_factor, dispatch=dispatch)
 
 
 def moe_reference(params: MoEParams, x, *, capacity_factor: float = 2.0):
