@@ -124,6 +124,27 @@ def longctx_table(rows: list[dict]) -> str:
     return "\n".join(out)
 
 
+def moe_table(rows: list[dict]) -> str:
+    if not rows:
+        return "_no MoE benchmark found_\n"
+    out = ["| model | platform | seq | batch | dispatch | tok/s "
+           "| TFLOPS/device (active) |",
+           "|---|---|---|---|---|---|---|"]
+    for r in rows:
+        disp = r.get("config", {}).get("moe_dispatch", "?")
+        plat = r.get("platform", "?")
+        if "error" in r:
+            out.append(f"| {r['model']} | {plat} | {r['seq_len']} | "
+                       f"{r['batch']} | {disp} | — | {r['error'][:50]} |")
+        else:
+            out.append(f"| {r['model']} | {plat} | {r['seq_len']} | "
+                       f"{r['batch']} | {disp} | "
+                       f"{r['tokens_per_sec']:.0f} | "
+                       f"{r['tflops_per_device']:.2f} |")
+    out.append("")
+    return "\n".join(out)
+
+
 def load_pp(dirname: str) -> list[dict]:
     return [r for r in _load_json_rows(dirname) if "schedule" in r]
 
@@ -148,12 +169,14 @@ def main(argv=None):
     p.add_argument("--precision-dir", default="precision_results")
     p.add_argument("--pp-dir", default="pp_results")
     p.add_argument("--longctx-dir", default="longcontext_results")
+    p.add_argument("--moe-dir", default="moe_results")
     p.add_argument("--out", default="RESULTS.md")
     args = p.parse_args(argv)
 
     prec = load_precision(args.precision_dir)
     pp = load_pp(args.pp_dir)
     longctx = load_longctx(args.longctx_dir)
+    moe = _load_json_rows(args.moe_dir)
     doc = [
         "# Benchmark results",
         "",
@@ -178,10 +201,18 @@ def main(argv=None):
         "(splash attention + streamed-vocab loss + full remat).",
         "",
         longctx_table(longctx),
+        "## MoE transformer (`scripts/moe_bench.py`)",
+        "",
+        "Switch-MoE flagship geometry (8 experts × 2752 ffn — the dense "
+        "3B-L8 MLP split 4-ways active), FSDP train step, sort-based vs "
+        "one-hot-einsum dispatch.  TFLOPS counts ACTIVE (top-1) FLOPs.",
+        "",
+        moe_table(moe),
     ]
     Path(args.out).write_text("\n".join(doc))
     print(f"[analyze] {len(prec)} precision rows, {len(pp)} pp rows, "
-          f"{len(longctx)} long-context rows -> {args.out}")
+          f"{len(longctx)} long-context rows, {len(moe)} moe rows "
+          f"-> {args.out}")
 
 
 if __name__ == "__main__":

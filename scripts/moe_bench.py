@@ -1,0 +1,59 @@
+"""MoE transformer benchmark: dispatch × batch on the local chip.
+
+Measures the switch-MoE flagship geometry (8 experts × moe_ffn 2752 —
+the dense 3B-L8's MLP FLOPs split 4-ways active) through the FSDP train
+step at seq 8192, comparing the sort-based dispatch against the one-hot
+einsum oracle.  Writes ``moe_results/moe_<platform>.json`` rows in the
+long-context sweep's schema (+ ``config``), consumed by
+``scripts/analyze_results.py``.
+
+    python scripts/moe_bench.py [--steps 6]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import bench  # noqa: E402
+
+BASE = {"n_experts": 8, "moe_ffn": 2752, "num_hidden_layers": 8}
+GRID = [({"moe_dispatch": "sort"}, 2), ({"moe_dispatch": "sort"}, 4),
+        ({"moe_dispatch": "einsum"}, 2), ({"moe_dispatch": "einsum"}, 4)]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="SMOLLM3_3B_L8")
+    p.add_argument("--seq", type=int, default=8192)
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--out-dir", default="moe_results")
+    args = p.parse_args(argv)
+
+    import jax
+    rows = []
+    for over, b in GRID:
+        cfgo = {**BASE, **over}
+        try:
+            r = bench.measure(args.model, args.seq, b,
+                              num_steps=args.steps, cfg_overrides=cfgo)
+            rows.append({**r, "config": cfgo})
+        except Exception as e:
+            rows.append({"model": args.model, "seq_len": args.seq,
+                         "batch": b, "config": cfgo,
+                         "error": f"{type(e).__name__}: {str(e)[:160]}"})
+        print(f"[moe-bench] {rows[-1]}", flush=True)
+
+    out = Path(args.out_dir)
+    out.mkdir(exist_ok=True)
+    path = out / f"moe_{jax.devices()[0].platform}.json"
+    path.write_text(json.dumps(rows, indent=1))
+    print(f"[moe-bench] wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

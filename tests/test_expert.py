@@ -69,6 +69,20 @@ def test_sort_dispatch_matches_einsum_dispatch(moe_params, cap_factor):
                                rtol=1e-6, atol=1e-6)
     assert float(auxs) == pytest.approx(float(auxe), abs=1e-6)
 
+    # backward too: every caller differentiates through the dispatch —
+    # dropped tokens must not leak gradient in either path.
+    def scalar(dispatch):
+        def f(x, wr, wg, wu, wd):
+            y, aux = expert.moe_mlp(x, wr, wg, wu, wd, axis=None,
+                                    dispatch=dispatch,
+                                    capacity_factor=cap_factor)
+            return jnp.sum(y * y) + aux
+        return f
+    gs = jax.grad(scalar("sort"), argnums=(0, 1, 2, 3, 4))(*args)
+    ge = jax.grad(scalar("einsum"), argnums=(0, 1, 2, 3, 4))(*args)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5), gs, ge)
+
 
 def test_moe_drops_overflow_tokens(moe_params):
     """At capacity_factor well below 1 some tokens MUST drop to zero."""
