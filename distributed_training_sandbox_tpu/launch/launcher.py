@@ -61,6 +61,7 @@ STRATEGY_SCRIPTS = {
     "train_tp": "train_tp.py",
     "tp": "train_tp.py",
     "moe": "moe.py",
+    "train_moe": "train_moe.py",
 }
 # (ops_demo / long_context / memory_waterline / analyze_results are NOT
 # registered: they don't speak the strategy CLI contract the launcher

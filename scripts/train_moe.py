@@ -1,0 +1,116 @@
+"""MoE-transformer training: switch-MoE MLP in every layer, dp × ep
+(the reference's MoE story is one README learning note — SURVEY.md §2.2;
+see ``parallel/expert.py`` and ``TransformerConfig.n_experts``).
+
+  python scripts/train_moe.py --cpu-devices 8 --ep 4 --experts 8 \\
+      --num-steps 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_training_sandbox_tpu.models import MODEL_REGISTRY as MODELS  # noqa: E402
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--model", choices=sorted(MODELS), default="tiny")
+    p.add_argument("--ep", type=int, default=2,
+                   help="size of the ep mesh axis (dp gets the rest)")
+    p.add_argument("--experts", type=int, default=8)
+    p.add_argument("--moe-ffn", type=int, default=0,
+                   help="per-expert ffn width (default intermediate/4)")
+    args, rest = p.parse_known_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    import jax
+    import jax.numpy as jnp
+    from distributed_training_sandbox_tpu.data import (
+        make_packed_dataset, packed_batches)
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.ops import count_collectives
+    from distributed_training_sandbox_tpu.parallel import expert, fsdp
+    from distributed_training_sandbox_tpu.utils import (
+        PerformanceTracker, TrainConfig, annotate, make_mesh,
+        print_memory_stats, set_seed)
+    from distributed_training_sandbox_tpu.utils.flops import (
+        get_model_flops_per_token)
+
+    cfg = TrainConfig.from_args(
+        rest, sequence_length=256 if args.model == "tiny" else 8192)
+    n_dev = len(jax.devices())
+    if args.ep < 1 or n_dev % args.ep:
+        raise SystemExit(f"--ep {args.ep} must be >= 1 and divide device "
+                         f"count {n_dev}")
+    if args.experts % args.ep:
+        raise SystemExit(f"--experts {args.experts} must be divisible by "
+                         f"ep={args.ep}")
+    dp = n_dev // args.ep
+    mesh = make_mesh({"dp": dp, "ep": args.ep})
+    base: T.TransformerConfig = getattr(T, MODELS[args.model])
+    mcfg = dataclasses.replace(
+        base, n_experts=args.experts,
+        moe_ffn=args.moe_ffn or max(base.intermediate_size // 4, 8))
+    if cfg.batch_size % n_dev:
+        cfg.batch_size = n_dev * max(1, cfg.batch_size // n_dev)
+    print(f"[train_moe] model={args.model} experts={args.experts} "
+          f"moe_ffn={mcfg.moe_ffn} ({mcfg.param_count()/1e9:.3f}B total) "
+          f"mesh={dict(mesh.shape)} batch={cfg.batch_size} "
+          f"seq={cfg.sequence_length} platform={jax.devices()[0].platform}")
+
+    key = set_seed(cfg.seed)
+    params = T.init_params(key, mcfg)
+    shards = expert.shard_moe_lm_params(params, mesh)
+    del params
+    opt_state = fsdp.init_fsdp_opt_state(shards)
+    print_memory_stats("train_moe-at-rest", params=shards,
+                       opt_state=opt_state)
+    step = expert.make_moe_lm_train_step(shards, mcfg, mesh)
+
+    input_ids, labels = make_packed_dataset(
+        cfg.sequence_length, mcfg.vocab_size,
+        num_tokens=max(cfg.batch_size * cfg.num_steps, 8)
+        * (cfg.sequence_length + 1))
+    probe = (jnp.zeros((cfg.batch_size, cfg.sequence_length), jnp.int32),) * 2
+    counts = count_collectives(step, shards, opt_state, probe)
+    print(f"[train_moe] per-step collectives (HLO): {counts} "
+          f"(a2a dispatch/return in the scanned layer body + grad syncs)")
+
+    tracker = PerformanceTracker(
+        warmup_steps=min(3, max(cfg.num_steps - 1, 0)),
+        flops_per_token=get_model_flops_per_token(mcfg,
+                                                  cfg.sequence_length),
+        num_devices=n_dev)
+    metrics = None
+    batches = packed_batches(input_ids, labels, cfg.batch_size,
+                             epochs=cfg.num_epochs * cfg.num_steps)
+    for i in range(cfg.num_steps):
+        with annotate("data_movement"):
+            bi, bl = next(batches)
+            batch = (jnp.asarray(bi), jnp.asarray(bl))
+        shards, opt_state, loss = step(shards, opt_state, batch)
+        jax.block_until_ready(loss)
+        metrics = tracker.step(cfg.batch_size * cfg.sequence_length,
+                               loss=float(loss))
+        if i % 5 == 0 or i == cfg.num_steps - 1:
+            print(f"[train_moe] step {i:3d} loss {float(loss):.4f}")
+    if metrics:
+        print(f"[train_moe] tokens/s {metrics['tokens_per_second']:.1f} "
+              f"TFLOPS/dev (active) "
+              f"{metrics.get('tflops_per_device', 0):.2f} "
+              f"avg_loss {metrics.get('avg_loss', float('nan')):.4f}")
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -35,3 +35,9 @@ def test_moe_script_learns():
     from scripts.moe import main
     m = main(["--num-steps", "25"])
     assert m["final_loss"] < m["first_loss"]
+
+
+def test_train_moe_script_runs():
+    from scripts.train_moe import main
+    m = main(["--ep", "4", "--num-steps", "3", "--sequence-length", "64"])
+    assert m and math.isfinite(m["avg_loss"])
