@@ -69,6 +69,9 @@ class TransformerConfig:
     # HBM; only valid inside shard_map (see parallel/sequence.py).
     attention_impl: str = "xla"  # "xla" | "flash" | "ring"
     sp_axis: str | None = None  # mesh axis the sequence is sharded on
+    # Ring q-chunk: bound each fold's fp32 score buffer to
+    # (B, n, ring_block_q, S_local); 0 = unchunked.  Must divide S_local.
+    ring_block_q: int = 0
     # Cross-entropy vocab chunk: None materializes full (B, S, vocab) fp32
     # logits (the reference's documented ~4 GB spikes, README.md:28-33);
     # an int streams the vocab through an online logsumexp in chunks of
@@ -325,7 +328,8 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
         attn = _attention_flash(q, k, v, scale).astype(x.dtype)
     elif cfg.attention_impl == "ring":  # sp_axis validated in __post_init__
         from ..ops.ring_attention import ring_attention
-        attn = ring_attention(q, k, v, cfg.sp_axis, scale=scale)
+        attn = ring_attention(q, k, v, cfg.sp_axis, scale=scale,
+                              block_q=cfg.ring_block_q or None)
     else:
         attn = _attention_xla(q, k, v, scale).astype(x.dtype)
     from jax.ad_checkpoint import checkpoint_name

@@ -39,13 +39,20 @@ def _block_scores(q, k, scale):
 
 
 def ring_attention(q, k, v, axis_name: str, *, scale: float,
-                   causal: bool = True) -> jax.Array:
+                   causal: bool = True,
+                   block_q: int | None = None) -> jax.Array:
     """Attention over a sequence sharded on ``axis_name`` (shard_map only).
 
     q, k, v: (B, S_local, n_heads, head_dim) — this device's contiguous
     chunk of the global sequence, chunks laid out in rank order.  GQA
     inputs (n_kv < n_q) are repeated up front.  Returns (B, S_local,
     n_heads, head_dim) in q's dtype.
+
+    ``block_q``: chunk the query rows of each fold so the fp32 score
+    buffer is (B, n, block_q, S_local) instead of (B, n, S_local,
+    S_local) — the flash-style memory bound that makes long LOCAL chunks
+    viable (at S_local=8k, nq=16 the unchunked buffer is 4 GB fp32 per
+    hop).  Must divide S_local; None/0 = unchunked.
     """
     n_dev = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -54,24 +61,28 @@ def ring_attention(q, k, v, axis_name: str, *, scale: float,
     rep = nq // nkv  # GQA: repeat per-block at compute time — the ring
     qf = q.astype(jnp.float32)  # carries (and ships) only the nkv heads
 
+    Cq = block_q if block_q and block_q < Sq else Sq
+    if Sq % Cq:
+        raise ValueError(f"block_q={block_q} must divide S_local={Sq}")
+    n_chunks = Sq // Cq
+    rows = jnp.arange(Cq)
+    cols = jnp.arange(Sq)
+
     # Ring: device i sends to i+1, so after t hops we hold block (my - t).
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-    tri = jnp.tril(jnp.ones((Sq, Sq), jnp.bool_))
 
-    def fold_block(src, k_blk, v_blk, m, l, o):
-        """Online-softmax merge of one visiting KV block into (m, l, o)."""
-        k_blk = k_blk.astype(jnp.float32)
-        v_blk = v_blk.astype(jnp.float32)
-        if rep != 1:
-            k_blk = jnp.repeat(k_blk, rep, axis=2)
-            v_blk = jnp.repeat(v_blk, rep, axis=2)
-        s = _block_scores(qf, k_blk, scale)
+    def merge_chunk(src, off, qc, k_blk, v_blk, m, l, o):
+        """Online-softmax merge of one KV block into one q-chunk's
+        (m, l, o).  ``off`` = the chunk's first row within the local
+        sequence; shapes: qc/o (B, Cq, n, hd), m/l (B, n, Cq, 1)."""
+        s = _block_scores(qc, k_blk, scale)                   # (B,n,Cq,Skv)
         if causal:
             # Global causality across contiguous blocks: earlier block ->
             # fully visible, own block -> lower triangle, later -> nothing.
-            blk = jnp.where(src == my, tri, src < my)
+            diag = cols[None, :] <= (off + rows)[:, None]     # (Cq, Skv)
+            blk = jnp.where(src == my, diag, src < my)
             s = jnp.where(blk[None, None], s, _NEG_INF)
-        m_blk = jnp.max(s, axis=-1, keepdims=True)            # (B,n,Sq,1)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)            # (B,n,Cq,1)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new)
         # A fully-masked block (src > my) must contribute zero even though
@@ -81,6 +92,34 @@ def ring_attention(q, k, v, axis_name: str, *, scale: float,
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         o = o * corr.swapaxes(1, 2) + jnp.einsum("bnqk,bknh->bqnh", p, v_blk)
         return m_new, l, o
+
+    def fold_block(src, k_blk, v_blk, m, l, o):
+        """Merge one visiting KV block into the whole local (m, l, o),
+        q-chunked when block_q is set (scan keeps one chunk's score
+        buffer live at a time)."""
+        k_blk = k_blk.astype(jnp.float32)
+        v_blk = v_blk.astype(jnp.float32)
+        if rep != 1:
+            k_blk = jnp.repeat(k_blk, rep, axis=2)
+            v_blk = jnp.repeat(v_blk, rep, axis=2)
+        if n_chunks == 1:
+            return merge_chunk(src, 0, qf, k_blk, v_blk, m, l, o)
+        qx = qf.reshape(B, n_chunks, Cq, nq, hd).transpose(1, 0, 2, 3, 4)
+        ox = o.reshape(B, n_chunks, Cq, nq, hd).transpose(1, 0, 2, 3, 4)
+        mx = m.reshape(B, nq, n_chunks, Cq, 1).transpose(2, 0, 1, 3, 4)
+        lx = l.reshape(B, nq, n_chunks, Cq, 1).transpose(2, 0, 1, 3, 4)
+        offs = jnp.arange(n_chunks) * Cq
+
+        def body(_, xs):
+            qc, mc, lc, oc, off = xs
+            return None, merge_chunk(src, off, qc, k_blk, v_blk,
+                                     mc, lc, oc)
+
+        _, (m2, l2, o2) = lax.scan(body, None, (qx, mx, lx, ox, offs))
+        m = m2.transpose(1, 2, 0, 3, 4).reshape(B, nq, Sq, 1)
+        l = l2.transpose(1, 2, 0, 3, 4).reshape(B, nq, Sq, 1)
+        o = o2.transpose(1, 0, 2, 3, 4).reshape(B, Sq, nq, hd)
+        return m, l, o
 
     def fold(carry, t):
         # Permute at iteration START: n_dev-1 hops total, no dead final

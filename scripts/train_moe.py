@@ -41,8 +41,8 @@ def main(argv=None):
     from distributed_training_sandbox_tpu.ops import count_collectives
     from distributed_training_sandbox_tpu.parallel import expert, fsdp
     from distributed_training_sandbox_tpu.utils import (
-        PerformanceTracker, TrainConfig, annotate, make_mesh,
-        print_memory_stats, set_seed)
+        PerformanceTracker, ProfileSchedule, Profiler, TrainConfig,
+        annotate, make_mesh, print_memory_stats, set_seed)
     from distributed_training_sandbox_tpu.utils.flops import (
         get_model_flops_per_token)
 
@@ -61,7 +61,17 @@ def main(argv=None):
     mcfg = dataclasses.replace(
         base, n_experts=args.experts,
         moe_ffn=args.moe_ffn or max(base.intermediate_size // 4, 8))
+    # consume the shared --precision knob (int8 variants raise loudly in
+    # TransformerConfig.__post_init__ — experts aren't quantized yet)
+    if cfg.precision.startswith("int8"):
+        mcfg = dataclasses.replace(mcfg, matmul_precision=cfg.precision)
+    elif cfg.precision == "fp32":
+        mcfg = dataclasses.replace(mcfg, dtype=jnp.float32)
     if cfg.batch_size % n_dev:
+        if any(r == "--batch-size" or r.startswith("--batch-size=")
+               for r in rest or []):
+            raise SystemExit(f"--batch-size {cfg.batch_size} must be "
+                             f"divisible by device count {n_dev}")
         cfg.batch_size = n_dev * max(1, cfg.batch_size // n_dev)
     print(f"[train_moe] model={args.model} experts={args.experts} "
           f"moe_ffn={mcfg.moe_ffn} ({mcfg.param_count()/1e9:.3f}B total) "
@@ -91,6 +101,10 @@ def main(argv=None):
         flops_per_token=get_model_flops_per_token(mcfg,
                                                   cfg.sequence_length),
         num_devices=n_dev)
+    prof = Profiler(trace_dir=cfg.trace_dir,
+                    schedule=ProfileSchedule(skip_first=0, wait=1,
+                                             warmup=2, active=5)) \
+        if cfg.profile else None
     metrics = None
     batches = packed_batches(input_ids, labels, cfg.batch_size,
                              epochs=cfg.num_epochs * cfg.num_steps)
@@ -102,8 +116,17 @@ def main(argv=None):
         jax.block_until_ready(loss)
         metrics = tracker.step(cfg.batch_size * cfg.sequence_length,
                                loss=float(loss))
+        if prof:
+            prof.step()
         if i % 5 == 0 or i == cfg.num_steps - 1:
             print(f"[train_moe] step {i:3d} loss {float(loss):.4f}")
+    if prof:
+        prof.stop()
+        from distributed_training_sandbox_tpu.utils.trace_analysis import (
+            split_from_trace)
+        sp_ = split_from_trace(cfg.trace_dir)
+        if sp_:
+            print(sp_.report("train_moe"))
     if metrics:
         print(f"[train_moe] tokens/s {metrics['tokens_per_second']:.1f} "
               f"TFLOPS/dev (active) "

@@ -37,14 +37,16 @@ def _qkv(key, B, S, nq, nkv, hd, dtype=jnp.float32):
 
 
 @pytest.mark.parametrize("nq,nkv", [(4, 4), (4, 2)])
-def test_ring_attention_matches_monolithic(mesh8, nq, nkv):
+@pytest.mark.parametrize("block_q", [None, 8])
+def test_ring_attention_matches_monolithic(mesh8, nq, nkv, block_q):
     B, S, hd = 2, 256, 16
     q, k, v = _qkv(jax.random.PRNGKey(0), B, S, nq, nkv, hd)
     scale = 1.0 / np.sqrt(hd)
     ref = T._attention_xla(q, k, v, scale)
 
     ring = jax.jit(smap(
-        lambda q, k, v: ring_attention(q, k, v, "dp", scale=scale),
+        lambda q, k, v: ring_attention(q, k, v, "dp", scale=scale,
+                                       block_q=block_q),
         mesh8, in_specs=P(None, "dp"), out_specs=P(None, "dp")))
     out = ring(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
