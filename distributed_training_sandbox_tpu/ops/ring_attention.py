@@ -61,12 +61,20 @@ def ring_attention(q, k, v, axis_name: str, *, scale: float,
     rep = nq // nkv  # GQA: repeat per-block at compute time — the ring
     qf = q.astype(jnp.float32)  # carries (and ships) only the nkv heads
 
+    if block_q is not None and block_q <= 0:
+        raise ValueError(f"block_q={block_q} must be a positive divisor "
+                         f"of S_local={Sq} (or None)")
     Cq = block_q if block_q and block_q < Sq else Sq
     if Sq % Cq:
         raise ValueError(f"block_q={block_q} must divide S_local={Sq}")
     n_chunks = Sq // Cq
     rows = jnp.arange(Cq)
     cols = jnp.arange(Sq)
+    if n_chunks > 1:
+        # chunk-major query layout, computed ONCE — m/l/o are carried
+        # chunk-major through the whole ring and reassembled at the end.
+        qx = qf.reshape(B, n_chunks, Cq, nq, hd).transpose(1, 0, 2, 3, 4)
+        offs = jnp.arange(n_chunks) * Cq
 
     # Ring: device i sends to i+1, so after t hops we hold block (my - t).
     perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
@@ -94,9 +102,10 @@ def ring_attention(q, k, v, axis_name: str, *, scale: float,
         return m_new, l, o
 
     def fold_block(src, k_blk, v_blk, m, l, o):
-        """Merge one visiting KV block into the whole local (m, l, o),
-        q-chunked when block_q is set (scan keeps one chunk's score
-        buffer live at a time)."""
+        """Merge one visiting KV block into the local (m, l, o) —
+        carried chunk-major ((n_chunks, B, ...) leading dim) when
+        block_q is set, so the per-hop scan keeps only one chunk's score
+        buffer live and no relayout happens inside the ring."""
         k_blk = k_blk.astype(jnp.float32)
         v_blk = v_blk.astype(jnp.float32)
         if rep != 1:
@@ -104,22 +113,14 @@ def ring_attention(q, k, v, axis_name: str, *, scale: float,
             v_blk = jnp.repeat(v_blk, rep, axis=2)
         if n_chunks == 1:
             return merge_chunk(src, 0, qf, k_blk, v_blk, m, l, o)
-        qx = qf.reshape(B, n_chunks, Cq, nq, hd).transpose(1, 0, 2, 3, 4)
-        ox = o.reshape(B, n_chunks, Cq, nq, hd).transpose(1, 0, 2, 3, 4)
-        mx = m.reshape(B, nq, n_chunks, Cq, 1).transpose(2, 0, 1, 3, 4)
-        lx = l.reshape(B, nq, n_chunks, Cq, 1).transpose(2, 0, 1, 3, 4)
-        offs = jnp.arange(n_chunks) * Cq
 
         def body(_, xs):
             qc, mc, lc, oc, off = xs
             return None, merge_chunk(src, off, qc, k_blk, v_blk,
                                      mc, lc, oc)
 
-        _, (m2, l2, o2) = lax.scan(body, None, (qx, mx, lx, ox, offs))
-        m = m2.transpose(1, 2, 0, 3, 4).reshape(B, nq, Sq, 1)
-        l = l2.transpose(1, 2, 0, 3, 4).reshape(B, nq, Sq, 1)
-        o = o2.transpose(1, 0, 2, 3, 4).reshape(B, Sq, nq, hd)
-        return m, l, o
+        _, out = lax.scan(body, None, (qx, m, l, o, offs))
+        return out
 
     def fold(carry, t):
         # Permute at iteration START: n_dev-1 hops total, no dead final
@@ -130,12 +131,20 @@ def ring_attention(q, k, v, axis_name: str, *, scale: float,
         m, l, o = fold_block((my - t) % n_dev, k_blk, v_blk, m, l, o)
         return (k_blk, v_blk, m, l, o), None
 
-    m0 = jnp.full((B, nq, Sq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, nq, Sq, 1), jnp.float32)
-    o0 = jnp.zeros((B, Sq, nq, hd), jnp.float32)
+    if n_chunks == 1:
+        m0 = jnp.full((B, nq, Sq, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, nq, Sq, 1), jnp.float32)
+        o0 = jnp.zeros((B, Sq, nq, hd), jnp.float32)
+    else:
+        m0 = jnp.full((n_chunks, B, nq, Cq, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((n_chunks, B, nq, Cq, 1), jnp.float32)
+        o0 = jnp.zeros((n_chunks, B, Cq, nq, hd), jnp.float32)
     m, l, o = fold_block(my, k, v, m0, l0, o0)          # t = 0: own block
     if n_dev > 1:
         (_, _, _, l, o), _ = lax.scan(fold, (k, v, m, l, o),
                                       jnp.arange(1, n_dev))
+    if n_chunks > 1:  # chunk-major -> (B, ...) once, after the ring
+        l = l.transpose(1, 2, 0, 3, 4).reshape(B, nq, Sq, 1)
+        o = o.transpose(1, 0, 2, 3, 4).reshape(B, Sq, nq, hd)
     l = jnp.where(l == 0.0, 1.0, l)  # rows with no visible keys (unused)
     return (o / l.swapaxes(1, 2)).astype(q.dtype)

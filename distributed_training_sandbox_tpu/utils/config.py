@@ -50,7 +50,11 @@ class TrainConfig:
 
 
 def build_argparser(parser: argparse.ArgumentParser | None = None):
-    p = parser or argparse.ArgumentParser(conflict_handler="resolve")
+    # allow_abbrev=False: scripts detect explicitly-passed flags by
+    # literal string match (e.g. the batch-size divisibility guards);
+    # prefix abbreviations would silently bypass those checks.
+    p = parser or argparse.ArgumentParser(conflict_handler="resolve",
+                                          allow_abbrev=False)
     p.add_argument("--num-steps", dest="num_steps", type=int, default=None)
     p.add_argument("--num-epochs", dest="num_epochs", type=int, default=None)
     p.add_argument("--batch-size", dest="batch_size", type=int, default=None)

@@ -98,6 +98,7 @@ def main(argv=None):
     variants = {}
     for name, over, b in (
         ("streamed_loss", {}, batch),
+        ("streamed_save_dots", {"remat_policy": "save_dots"}, batch),
         ("dense_loss", {"loss_vocab_chunk": None}, batch),
         ("streamed_no_remat", {"remat": False}, batch),
         ("streamed_loss_b1", {}, (ids1, ids1)),
@@ -206,6 +207,14 @@ log-probs, grad-wrt-log-probs).
   backward) vs {variants['streamed_loss']['temp_gb']:.2f} GB with remat
   — the FLOPs-for-HBM trade the reference's `reshard_after_forward`
   comments gesture at, applied to activations.
+* `streamed_save_dots` (remat_policy="save_dots") keeps every matmul
+  output resident so the backward recomputes only elementwise ops:
+  {'the plan exceeds HBM at this config (' + format(variants['streamed_save_dots'].get('needed_gb', 0), '.2f') + ' GB needed)'
+   if variants['streamed_save_dots'].get('oom') else
+   'it plans ' + format(variants['streamed_save_dots']['temp_gb'], '.2f') + ' GB of temp'}
+  — the FLOPs-vs-HBM middle point between full remat and no remat
+  (throughput for each policy is measured separately by `bench.py`;
+  see `bench_matrix_tpu.json`).
 
 ## Reading guide vs the reference
 
