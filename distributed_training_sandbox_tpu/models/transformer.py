@@ -496,18 +496,25 @@ def lm_loss(params: dict, batch, cfg: TransformerConfig,
     input_ids, labels = batch
     x, aux = hidden_states(params, input_ids, cfg, layer_hook=layer_hook,
                            layer_body=layer_body, return_aux=True)
-    if cfg.loss_vocab_chunk:
-        loss = chunked_softmax_xent(x, _output_embedding(params, cfg),
-                                    labels, cfg.loss_vocab_chunk)
-    else:
-        logits = (x @ _output_embedding(params, cfg).T).astype(jnp.float32)
-        logz = jax.scipy.special.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, labels[..., None],
-                                   axis=-1)[..., 0]
-        loss = jnp.mean(logz - gold)
+    loss = xent_from_hidden(x, _output_embedding(params, cfg), labels,
+                            chunk=cfg.loss_vocab_chunk)
     if cfg.n_experts:
         loss = loss + cfg.moe_aux_weight * aux
     return loss
+
+
+def xent_from_hidden(x: jax.Array, w_vocab: jax.Array, labels: jax.Array,
+                     *, chunk: int | None = None) -> jax.Array:
+    """Mean causal-LM cross-entropy from final hidden states:
+    streamed-vocab when ``chunk`` is set, dense fp32 otherwise.
+    ``w_vocab``: (vocab, H) unembedding rows.  Shared by ``lm_loss`` and
+    the pipeline's last stage so the numerics exist once."""
+    if chunk:
+        return chunked_softmax_xent(x, w_vocab, labels, chunk)
+    logits = (x @ w_vocab.T).astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
 
 
 def model_flops_per_token(cfg: TransformerConfig, seq_len: int) -> float:

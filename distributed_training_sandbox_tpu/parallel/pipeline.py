@@ -72,7 +72,15 @@ class PipelineStage:
         self.params = jax.device_put(stage_params, device)
         self.is_last = is_last
         apply = apply_fn
-        loss = loss_fn or (lambda out, y: jnp.mean((out - y) ** 2))
+        loss2 = loss_fn or (lambda out, y: jnp.mean((out - y) ** 2))
+        # a loss may also take the stage params (3-arg form) — how the
+        # transformer's last stage reaches its unembedding for the
+        # streamed-vocab loss.
+        import inspect
+        if len(inspect.signature(loss2).parameters) >= 3:
+            loss = loss2
+        else:
+            loss = lambda out, y, p: loss2(out, y)  # noqa: E731
 
         def fwd(p, x):
             return apply(p, x)
@@ -84,7 +92,7 @@ class PipelineStage:
 
         def last_fwd_bwd(p, x, y, inv_n_micro):
             def scaled(p, x):
-                return loss(apply(p, x), y) * inv_n_micro
+                return loss(apply(p, x), y, p) * inv_n_micro
             (l, (gp, gx)) = jax.value_and_grad(scaled, argnums=(0, 1))(p, x)
             return l, gp, gx
 
@@ -136,6 +144,99 @@ def build_pipeline(params: list, n_stages: int,
         apply = apply_fn or partial(mlp_apply_stage, last_stage=is_last)
         stages.append(PipelineStage(chunk, devs[s % len(devs)], apply,
                                     is_last=is_last, loss_fn=loss_fn))
+    return stages
+
+
+def build_transformer_pipeline(params: dict, cfg, n_stages: int,
+                               devices: Sequence[jax.Device] | None = None
+                               ) -> list[PipelineStage]:
+    """Stage the real LM (``models.transformer``) over ``n_stages``
+    devices — the extension past the reference's toy-MLP-only pipelines:
+    stage 0 embeds and runs its layer slice, middle stages run layers,
+    the last stage adds final norm + unembedding + the LM loss.
+
+    Layer slices stay in stacked (L_s, ...) form, so each stage's forward
+    is the same ``lax.scan`` over ``_layer_body`` the monolithic model
+    uses (NoPE flags sliced per stage by GLOBAL layer index).
+
+    Tied embeddings are untied here: with per-stage optimizers (the
+    reference's design, ``gpipe.py:57``) the embedding would need a
+    cross-stage grad sum every step to stay shared; instead the last
+    stage gets its own unembedding initialized from ``embed`` (or the
+    existing ``lm_head``) and the two train independently from then on.
+    """
+    import numpy as np
+
+    from ..models import transformer as T
+
+    if cfg.n_experts:
+        raise ValueError(
+            "build_transformer_pipeline does not thread the MoE "
+            "load-balance aux loss across stages yet — stage a dense "
+            "config (n_experts=0)")
+    L = cfg.num_hidden_layers
+    if n_stages > L:
+        raise ValueError(f"n_stages={n_stages} exceeds "
+                         f"num_hidden_layers={L}")
+    flags = np.asarray(T._rope_flags(cfg))
+    layer_slices = split_stages(list(range(L)), n_stages)
+    devs = list(devices if devices is not None else jax.local_devices())
+
+    head = params.get("lm_head")
+    if head is None:
+        head = jnp.asarray(params["embed"]).T.copy()  # untie (see above)
+
+    stages = []
+    for s, idxs in enumerate(layer_slices):
+        lo, hi = idxs[0], idxs[-1] + 1
+        first, last = s == 0, s == n_stages - 1
+        sp = {"layers": jax.tree.map(lambda v: v[lo:hi],
+                                     params["layers"])}
+        if first:
+            sp["embed"] = params["embed"]
+        if last:
+            sp["final_norm"] = params["final_norm"]
+            sp["lm_head"] = head
+        stage_flags = jnp.asarray(flags[lo:hi])
+
+        def apply(p, x, *, _first=first, _last=last,
+                  _flags=stage_flags):
+            if _first:
+                x = p["embed"].astype(cfg.dtype)[x]
+            B, S = x.shape[:2]
+            cos, sin = T._rope_tables(S, cfg.resolved_head_dim,
+                                      cfg.rope_theta)
+
+            def body(carry, scanned):
+                layer, use_rope = scanned
+                h, _aux = T._layer_body(carry, layer, cfg=cfg, cos=cos,
+                                        sin=sin, use_rope=use_rope)
+                return h, None
+
+            if cfg.remat:
+                policy = {
+                    "save_attn": jax.checkpoint_policies
+                    .save_only_these_names("attn_out"),
+                    "save_dots": jax.checkpoint_policies
+                    .dots_with_no_batch_dims_saveable,
+                    "full": None,
+                }[cfg.remat_policy]
+                body = jax.checkpoint(body, prevent_cse=False,
+                                      policy=policy)
+            x, _ = jax.lax.scan(body, x, (p["layers"], _flags))
+            if _last:
+                return T.rms_norm(x, p["final_norm"], cfg.rms_norm_eps)
+            return x
+
+        def lm_xent(hidden, labels, p):
+            # shared numerics with lm_loss (streamed vocab honored);
+            # lm_head is (H, vocab), xent wants (vocab, H) rows.
+            return T.xent_from_hidden(
+                hidden, p["lm_head"].astype(cfg.dtype).T, labels,
+                chunk=cfg.loss_vocab_chunk)
+
+        stages.append(PipelineStage(sp, devs[s % len(devs)], apply,
+                                    is_last=last, loss_fn=lm_xent))
     return stages
 
 

@@ -40,8 +40,18 @@ class TrainConfig:
     @classmethod
     def from_args(cls, argv=None, **overrides) -> "TrainConfig":
         """CLI args win; ``overrides`` are script-specific *defaults* that
-        apply only where the user passed nothing."""
-        ns, _ = build_argparser().parse_known_args(argv)
+        apply only where the user passed nothing.
+
+        This is the LAST parser in every script's chain, so leftover
+        ``--flags`` are typos or abbreviations (abbrev is disabled) —
+        silently dropping them would mean training with a different
+        config than the user asked for; error instead."""
+        ns, rest = build_argparser().parse_known_args(argv)
+        unknown = [a for a in rest if a.startswith("--")]
+        if unknown:
+            raise SystemExit(
+                f"unrecognized training flags: {' '.join(unknown)} "
+                f"(abbreviations are not accepted; see --help)")
         kwargs = {f.name: getattr(ns, f.name) for f in fields(cls)
                   if hasattr(ns, f.name) and getattr(ns, f.name) is not None}
         for k, v in overrides.items():
