@@ -363,6 +363,19 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     return x + mlp, aux
 
 
+def resolve_remat_policy(cfg: TransformerConfig):
+    """cfg.remat_policy name → jax.checkpoint policy (one mapping for
+    every scaffold that remats the layer scan — hidden_states and
+    parallel/pipeline's stage bodies)."""
+    return {
+        "save_attn":
+            jax.checkpoint_policies.save_only_these_names("attn_out"),
+        "save_dots":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "full": None,
+    }[cfg.remat_policy]
+
+
 def _rope_flags(cfg: TransformerConfig) -> jax.Array:
     """Per-layer use-RoPE flags: SmolLM3 drops RoPE on every
     ``nope_interval``-th layer."""
@@ -420,14 +433,8 @@ def hidden_states(params: dict, input_ids: jax.Array,
         return x, aux
 
     if cfg.remat:
-        policy = {
-            "save_attn":
-                jax.checkpoint_policies.save_only_these_names("attn_out"),
-            "save_dots":
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            "full": None,
-        }[cfg.remat_policy]
-        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
+        body = jax.checkpoint(body, prevent_cse=False,
+                              policy=resolve_remat_policy(cfg))
     x, aux = lax.scan(body, x, (params["layers"], flags))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return (x, jnp.sum(aux)) if return_aux else x

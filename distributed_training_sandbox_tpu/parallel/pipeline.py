@@ -77,7 +77,12 @@ class PipelineStage:
         # transformer's last stage reaches its unembedding for the
         # streamed-vocab loss.
         import inspect
-        if len(inspect.signature(loss2).parameters) >= 3:
+        params_ = inspect.signature(loss2).parameters.values()
+        required_pos = sum(
+            1 for q in params_
+            if q.kind in (q.POSITIONAL_ONLY, q.POSITIONAL_OR_KEYWORD)
+            and q.default is q.empty)
+        if required_pos >= 3:
             loss = loss2
         else:
             loss = lambda out, y, p: loss2(out, y)  # noqa: E731
@@ -214,15 +219,9 @@ def build_transformer_pipeline(params: dict, cfg, n_stages: int,
                 return h, None
 
             if cfg.remat:
-                policy = {
-                    "save_attn": jax.checkpoint_policies
-                    .save_only_these_names("attn_out"),
-                    "save_dots": jax.checkpoint_policies
-                    .dots_with_no_batch_dims_saveable,
-                    "full": None,
-                }[cfg.remat_policy]
-                body = jax.checkpoint(body, prevent_cse=False,
-                                      policy=policy)
+                body = jax.checkpoint(
+                    body, prevent_cse=False,
+                    policy=T.resolve_remat_policy(cfg))
             x, _ = jax.lax.scan(body, x, (p["layers"], _flags))
             if _last:
                 return T.rms_norm(x, p["final_norm"], cfg.rms_norm_eps)
@@ -235,8 +234,9 @@ def build_transformer_pipeline(params: dict, cfg, n_stages: int,
                 hidden, p["lm_head"].astype(cfg.dtype).T, labels,
                 chunk=cfg.loss_vocab_chunk)
 
-        stages.append(PipelineStage(sp, devs[s % len(devs)], apply,
-                                    is_last=last, loss_fn=lm_xent))
+        stages.append(PipelineStage(
+            sp, devs[s % len(devs)], apply, is_last=last,
+            loss_fn=lm_xent if last else None))  # only last has lm_head
     return stages
 
 

@@ -16,10 +16,18 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def main(schedule: str, argv=None):
+    from distributed_training_sandbox_tpu.models import (
+        MODEL_REGISTRY as MODELS)
+
     p = argparse.ArgumentParser()
     p.add_argument("--cpu-devices", type=int, default=0)
     p.add_argument("--n-stages", type=int, default=2)
     p.add_argument("--n-micro", type=int, default=4)
+    p.add_argument("--model", choices=["mlp"] + sorted(MODELS),
+                   default="mlp",
+                   help="mlp = the reference's toy stack; otherwise "
+                        "stage that transformer config "
+                        "(build_transformer_pipeline)")
     p.add_argument("--results-file", type=str, default=None)
     args, rest = p.parse_known_args(argv)
 
@@ -31,26 +39,41 @@ def main(schedule: str, argv=None):
     from distributed_training_sandbox_tpu.utils import (
         TrainConfig, set_seed, Profiler, ProfileSchedule)
     from distributed_training_sandbox_tpu.models import pp_toy_mlp
+    from distributed_training_sandbox_tpu.models import transformer as T
     from distributed_training_sandbox_tpu.models.mlp import PP_TOY_SIZES
     from distributed_training_sandbox_tpu.parallel.pipeline import (
-        build_pipeline, train_pipeline)
+        build_pipeline, build_transformer_pipeline, train_pipeline)
 
-    cfg = TrainConfig.from_args(rest, batch_size=64, num_epochs=16)
+    cfg = TrainConfig.from_args(
+        rest, batch_size=64, num_epochs=16,
+        sequence_length=256 if args.model != "mlp" else 8192)
     key = set_seed(cfg.seed)
-    params = pp_toy_mlp(key)
-    stages = build_pipeline(params, args.n_stages)
+    if args.model == "mlp":
+        params = pp_toy_mlp(key)
+        stages = build_pipeline(params, args.n_stages)
+        width_in, width_out = PP_TOY_SIZES[0], PP_TOY_SIZES[-1]
+
+        def make_batch(epoch):
+            # fresh synthetic batch per epoch (reference gpipe.py:175-176)
+            k = jax.random.fold_in(key, epoch)
+            kx, ky = jax.random.split(k)
+            return (jax.random.normal(kx, (cfg.batch_size, width_in)),
+                    jax.random.normal(ky, (cfg.batch_size, width_out)))
+    else:
+        mcfg: T.TransformerConfig = getattr(T, MODELS[args.model])
+        params = T.init_params(key, mcfg)
+        stages = build_transformer_pipeline(params, mcfg, args.n_stages)
+
+        def make_batch(epoch):
+            k = jax.random.fold_in(key, epoch)
+            ids = jax.random.randint(
+                k, (cfg.batch_size, cfg.sequence_length), 0,
+                mcfg.vocab_size)
+            import jax.numpy as jnp
+            return ids, jnp.roll(ids, -1, axis=1)
     devs = [str(s.device) for s in stages]
-    print(f"[{schedule}] stages={args.n_stages} micro={args.n_micro} "
-          f"devices={devs}")
-
-    width_in, width_out = PP_TOY_SIZES[0], PP_TOY_SIZES[-1]
-
-    def make_batch(epoch):
-        # fresh synthetic batch per epoch (reference gpipe.py:175-176)
-        k = jax.random.fold_in(key, epoch)
-        kx, ky = jax.random.split(k)
-        return (jax.random.normal(kx, (cfg.batch_size, width_in)),
-                jax.random.normal(ky, (cfg.batch_size, width_out)))
+    print(f"[{schedule}] model={args.model} stages={args.n_stages} "
+          f"micro={args.n_micro} devices={devs}")
 
     prof = Profiler(trace_dir=cfg.trace_dir,
                     schedule=ProfileSchedule(skip_first=2, wait=1, warmup=1,
