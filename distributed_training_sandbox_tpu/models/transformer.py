@@ -111,12 +111,6 @@ class TransformerConfig:
                 "attention_impl='ring' needs sp_axis set to the mesh axis "
                 "the sequence is sharded on, and must run inside shard_map "
                 "(see parallel.sequence.sp_config)")
-        if self.n_experts and self.matmul_precision != "bf16":
-            raise ValueError(
-                "quantized matmul_precision is not implemented for the "
-                "MoE expert MLPs — attention would quantize while the "
-                "experts silently wouldn't; use matmul_precision='bf16' "
-                "with n_experts")
 
     @property
     def resolved_head_dim(self) -> int:
@@ -291,14 +285,8 @@ def _dense(cfg: TransformerConfig):
     """The projection matmul at the configured precision.  Precisions:
     bf16; int8 (XLA fwd); int8_pallas (fused quantize-matmul kernel fwd);
     *_bwd variants additionally run both backward matmuls at int8."""
-    if cfg.matmul_precision == "bf16":
-        return lambda a, w: a @ w
-    from ..ops import quant as Q
-    base = cfg.matmul_precision.removesuffix("_bwd")
-    impl = {"int8": "xla", "int8_pallas": "pallas_fused"}[base]
-    quantize_bwd = cfg.matmul_precision.endswith("_bwd")
-    interp = jax.default_backend() != "tpu"
-    return lambda a, w: Q.quantized_dense(a, w, impl, interp, quantize_bwd)
+    from ..ops.quant import resolve_quantized_dense
+    return resolve_quantized_dense(cfg.matmul_precision)
 
 
 def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
@@ -353,7 +341,8 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
                            layer["w_up"], layer["w_down"],
                            axis=cfg.ep_axis,
                            capacity_factor=cfg.moe_capacity_factor,
-                           dispatch=cfg.moe_dispatch)
+                           dispatch=cfg.moe_dispatch,
+                           matmul_precision=cfg.matmul_precision)
     else:
         mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
                     * dense(r, layer["w_up"]), layer["w_down"])

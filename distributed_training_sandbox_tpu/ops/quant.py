@@ -184,6 +184,22 @@ def _int8_dot(aq, a_scale, bq, b_scale, dims, out_dtype):
 
 # ------------------------------------------------------------- training
 
+def resolve_quantized_dense(precision: str):
+    """``matmul_precision`` name → ``(a, w) -> out`` matmul, the ONE
+    mapping shared by the attention projections (``transformer._dense``)
+    and the per-expert MoE matmuls (``parallel.expert.moe_mlp``), so the
+    same precision string always selects the same impl everywhere.
+    ``"bf16"`` returns a plain matmul."""
+    if precision == "bf16":
+        return lambda a, w: a @ w
+    base = precision.removesuffix("_bwd")
+    impl = {"int8": "xla", "int8_pallas": "pallas_fused"}[base]
+    quantize_bwd = precision.endswith("_bwd")
+    interpret = jax.default_backend() != "tpu"
+    return lambda a, w: quantized_dense(a, w, impl, interpret,
+                                        quantize_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
 def quantized_dense(x, w, impl: str = "xla", interpret: bool = False,
                     quantize_bwd: bool = False):

@@ -85,7 +85,8 @@ def _route_top1(x2d, w_router):
 
 
 def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
-            capacity_factor: float = 2.0, dispatch: str = "sort"):
+            capacity_factor: float = 2.0, dispatch: str = "sort",
+            matmul_precision: str = "bf16"):
     """The switch-MoE MLP on local tokens ``x`` (B, S, H) →
     ``(y, aux_loss)``.  ``w_gate/w_up/w_down`` hold this device's
     ``E_local`` experts on dim 0; ``axis=None`` means no expert
@@ -161,10 +162,19 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
 
     with scope("moe_expert_mlp"):
         toks = recv.transpose(1, 0, 2, 3).reshape(E_local, ep * cap, H)
-        h_gate = jnp.einsum("eth,ehf->etf", toks, w_gate)
-        h_up = jnp.einsum("eth,ehf->etf", toks, w_up)
-        out = jnp.einsum("etf,efh->eth", jax.nn.silu(h_gate) * h_up,
-                         w_down)                               # (El, ep*C, H)
+        if matmul_precision == "bf16":
+            pe_dense = lambda a, wgt: jnp.einsum(  # noqa: E731
+                "etk,ekn->etn", a, wgt)
+        else:
+            # per-expert dynamically-quantized matmuls: vmap the same
+            # resolver the attention projections use (ops/quant.py), so
+            # one precision string selects one impl everywhere.
+            from ..ops.quant import resolve_quantized_dense
+            pe_dense = jax.vmap(resolve_quantized_dense(matmul_precision))
+        h_gate = pe_dense(toks, w_gate)
+        h_up = pe_dense(toks, w_up)
+        out = pe_dense(jax.nn.silu(h_gate) * h_up,
+                       w_down)                                 # (El, ep*C, H)
 
     with scope("moe_a2a_back"):
         back = out.reshape(E_local, ep, cap, H).transpose(1, 0, 2, 3)

@@ -36,6 +36,7 @@ def main(schedule: str, argv=None):
         use_cpu_devices(args.cpu_devices)
 
     import jax
+    import jax.numpy as jnp
     from distributed_training_sandbox_tpu.utils import (
         TrainConfig, set_seed, Profiler, ProfileSchedule)
     from distributed_training_sandbox_tpu.models import pp_toy_mlp
@@ -69,7 +70,6 @@ def main(schedule: str, argv=None):
             ids = jax.random.randint(
                 k, (cfg.batch_size, cfg.sequence_length), 0,
                 mcfg.vocab_size)
-            import jax.numpy as jnp
             return ids, jnp.roll(ids, -1, axis=1)
     devs = [str(s.device) for s in stages]
     print(f"[{schedule}] model={args.model} stages={args.n_stages} "

@@ -127,18 +127,21 @@ def longctx_table(rows: list[dict]) -> str:
 def moe_table(rows: list[dict]) -> str:
     if not rows:
         return "_no MoE benchmark found_\n"
-    out = ["| model | platform | seq | batch | dispatch | tok/s "
-           "| TFLOPS/device (active) |",
-           "|---|---|---|---|---|---|---|"]
+    out = ["| model | platform | seq | batch | dispatch | precision "
+           "| tok/s | TFLOPS/device (active) |",
+           "|---|---|---|---|---|---|---|---|"]
     for r in rows:
-        disp = r.get("config", {}).get("moe_dispatch", "?")
+        c = r.get("config", {})
+        disp = c.get("moe_dispatch", "?")
+        prec = c.get("matmul_precision", "bf16")
         plat = r.get("platform", "?")
         if "error" in r:
             out.append(f"| {r['model']} | {plat} | {r['seq_len']} | "
-                       f"{r['batch']} | {disp} | — | {r['error'][:50]} |")
+                       f"{r['batch']} | {disp} | {prec} | — | "
+                       f"{r['error'][:50]} |")
         else:
             out.append(f"| {r['model']} | {plat} | {r['seq_len']} | "
-                       f"{r['batch']} | {disp} | "
+                       f"{r['batch']} | {disp} | {prec} | "
                        f"{r['tokens_per_sec']:.0f} | "
                        f"{r['tflops_per_device']:.2f} |")
     out.append("")

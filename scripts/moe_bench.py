@@ -23,7 +23,9 @@ import bench  # noqa: E402
 
 BASE = {"n_experts": 8, "moe_ffn": 2752, "num_hidden_layers": 8}
 GRID = [({"moe_dispatch": "sort"}, 2), ({"moe_dispatch": "sort"}, 4),
-        ({"moe_dispatch": "einsum"}, 2), ({"moe_dispatch": "einsum"}, 4)]
+        ({"moe_dispatch": "einsum"}, 2), ({"moe_dispatch": "einsum"}, 4),
+        ({"moe_dispatch": "sort", "matmul_precision": "int8_bwd"}, 2),
+        ({"moe_dispatch": "sort", "matmul_precision": "int8_bwd"}, 4)]
 
 
 def main(argv=None):

@@ -61,8 +61,8 @@ def main(argv=None):
     mcfg = dataclasses.replace(
         base, n_experts=args.experts,
         moe_ffn=args.moe_ffn or max(base.intermediate_size // 4, 8))
-    # consume the shared --precision knob (int8 variants raise loudly in
-    # TransformerConfig.__post_init__ — experts aren't quantized yet)
+    # consume the shared --precision knob (int8 variants quantize the
+    # attention projections AND the per-expert MLP matmuls)
     if cfg.precision.startswith("int8"):
         mcfg = dataclasses.replace(mcfg, matmul_precision=cfg.precision)
     elif cfg.precision == "fp32":

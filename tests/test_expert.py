@@ -84,6 +84,28 @@ def test_sort_dispatch_matches_einsum_dispatch(moe_params, cap_factor):
         np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5), gs, ge)
 
 
+@pytest.mark.parametrize("precision",
+                         ["int8", "int8_bwd", "int8_pallas"])
+def test_moe_quantized_experts(moe_params, precision):
+    """Per-expert int8 matmuls (vmapped quantized_dense): outputs track
+    the bf16 path within quantization error and gradients stay finite."""
+    x = jax.random.normal(jax.random.PRNGKey(8), (1, 32, HID))
+    args = (x, moe_params.w_router, moe_params.w_gate, moe_params.w_up,
+            moe_params.w_down)
+    yb, _ = expert.moe_mlp(*args, axis=None, capacity_factor=8.0)
+    yq, _ = expert.moe_mlp(*args, axis=None, capacity_factor=8.0,
+                           matmul_precision=precision)
+    # int8 dynamic quantization error at 2 stacked matmuls: loose bound
+    err = np.abs(np.asarray(yq) - np.asarray(yb)).max()
+    mag = np.abs(np.asarray(yb)).max()
+    assert err < 0.1 * mag + 1e-3, (err, mag)
+
+    g = jax.grad(lambda x: jnp.sum(expert.moe_mlp(
+        x, *args[1:], axis=None, capacity_factor=8.0,
+        matmul_precision=precision)[0] ** 2))(x)
+    assert np.isfinite(np.asarray(g)).all()
+
+
 def test_moe_drops_overflow_tokens(moe_params):
     """At capacity_factor well below 1 some tokens MUST drop to zero."""
     x = _tokens(jax.random.PRNGKey(2), 1, 64)
