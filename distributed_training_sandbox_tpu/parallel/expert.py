@@ -162,15 +162,12 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
 
     with scope("moe_expert_mlp"):
         toks = recv.transpose(1, 0, 2, 3).reshape(E_local, ep * cap, H)
-        if matmul_precision == "bf16":
-            pe_dense = lambda a, wgt: jnp.einsum(  # noqa: E731
-                "etk,ekn->etn", a, wgt)
-        else:
-            # per-expert dynamically-quantized matmuls: vmap the same
-            # resolver the attention projections use (ops/quant.py), so
-            # one precision string selects one impl everywhere.
-            from ..ops.quant import resolve_quantized_dense
-            pe_dense = jax.vmap(resolve_quantized_dense(matmul_precision))
+        # per-expert matmuls: vmap the same precision resolver the
+        # attention projections use (ops/quant.py) over the expert dim —
+        # one precision string selects one impl everywhere (bf16 included:
+        # vmap of a plain matmul lowers to the same batched dot_general).
+        from ..ops.quant import resolve_quantized_dense
+        pe_dense = jax.vmap(resolve_quantized_dense(matmul_precision))
         h_gate = pe_dense(toks, w_gate)
         h_up = pe_dense(toks, w_up)
         out = pe_dense(jax.nn.silu(h_gate) * h_up,

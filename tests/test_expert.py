@@ -53,7 +53,7 @@ def test_moe_layer_matches_dense_oracle(mesh8, moe_params, cap_factor):
                                rtol=2e-5, atol=2e-5)
 
 
-@pytest.mark.parametrize("cap_factor", [8.0, 0.75])
+@pytest.mark.parametrize("cap_factor", [8.0, 1.0, 0.75])
 def test_sort_dispatch_matches_einsum_dispatch(moe_params, cap_factor):
     """The O(N·H) sort dispatch computes exactly what the one-hot
     einsum oracle computes — same outputs, same drop set, same aux —

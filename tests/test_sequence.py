@@ -53,6 +53,23 @@ def test_ring_attention_matches_monolithic(mesh8, nq, nkv, block_q):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ring_attention_bf16_inputs(mesh8):
+    """The production dtype: bf16 q/k/v, fp32 accumulators inside —
+    output must match the monolithic bf16 reference within bf16 noise."""
+    B, S, n, hd = 2, 128, 4, 16
+    q, k, v = _qkv(jax.random.PRNGKey(9), B, S, n, n, hd, jnp.bfloat16)
+    scale = 1.0 / np.sqrt(hd)
+    ref = T._attention_xla(q, k, v, scale)
+    ring = jax.jit(smap(
+        lambda q, k, v: ring_attention(q, k, v, "dp", scale=scale),
+        mesh8, in_specs=P(None, "dp"), out_specs=P(None, "dp")))
+    out = ring(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=0.05, atol=0.05)
+
+
 def test_ring_attention_noncausal(mesh8):
     B, S, n, hd = 1, 128, 2, 8
     q, k, v = _qkv(jax.random.PRNGKey(1), B, S, n, n, hd)
