@@ -265,6 +265,7 @@ def make_moe_lm_train_step(
     *,
     dp_axis: str = "dp",
     ep_axis: str = "ep",
+    sp_axis: str | None = None,
     lr: float = 3e-4,
     b1: float = 0.9,
     b2: float = 0.95,
@@ -278,7 +279,13 @@ def make_moe_lm_train_step(
     group — every device routes only its own token shard); each layer's
     MoE MLP all_to_alls tokens to the expert owners across the ep row
     and back.  Expert grads arrive via the all_to_all transposes (psum
-    over dp only); dense/router grads mean-psum over the whole group."""
+    over dp only); dense/router grads mean-psum over the whole group.
+
+    ``sp_axis`` makes it the dp×sp×ep step: the sequence dim additionally
+    shards over ``sp_axis`` with ring attention (each device then routes
+    its B_local × S_local tokens — routing is per-token, so the expert
+    choreography is unchanged; only the chunk the capacity is computed
+    over shrinks)."""
     import dataclasses
 
     from ..models import transformer as T
@@ -290,12 +297,26 @@ def make_moe_lm_train_step(
     if cfg.n_experts % ws_ep:
         raise ValueError(f"n_experts={cfg.n_experts} must be divisible "
                          f"by ep={ws_ep}")
+    if sp_axis is None and cfg.sp_axis is not None:
+        raise ValueError(
+            f"cfg.sp_axis={cfg.sp_axis!r} (ring attention) but "
+            f"make_moe_lm_train_step got sp_axis=None — the batch would "
+            f"replicate over {cfg.sp_axis!r} and sp grads would never "
+            f"sync.  Pass sp_axis={cfg.sp_axis!r} (the step sets the "
+            f"ring config itself).")
     cfg = dataclasses.replace(cfg, ep_axis=ep_axis)
     n_total = ws_dp * ws_ep
+    rep_axes = [dp_axis]
+    if sp_axis is not None:
+        cfg = dataclasses.replace(cfg, attention_impl="ring",
+                                  sp_axis=sp_axis)
+        n_total *= int(mesh.shape[sp_axis])
+        rep_axes.append(sp_axis)
     specs = moe_lm_specs(params_sharded, ep_axis)
 
     def sync_grad(g, spec):
-        axes = (dp_axis,) if ep_axis in spec else (dp_axis, ep_axis)
+        axes = tuple(rep_axes) + ((ep_axis,) if ep_axis not in spec
+                                  else ())
         return jax.lax.psum(g, axes) / n_total
 
     def step(shards, opt_state, batch):
@@ -303,8 +324,8 @@ def make_moe_lm_train_step(
             loss, grads = jax.value_and_grad(
                 lambda p: T.lm_loss(p, batch, cfg))(shards)
         with scope("loss_mean"):
-            loss = C.all_reduce(C.all_reduce(loss, dp_axis, mean=True),
-                                ep_axis, mean=True)
+            # one fused mean over every axis (equal shard sizes)
+            loss = jax.lax.pmean(loss, tuple(rep_axes + [ep_axis]))
         with scope("grad_sync"):
             grads = jax.tree.map(sync_grad, grads, specs,
                                  is_leaf=lambda x: isinstance(x, P))
@@ -314,9 +335,10 @@ def make_moe_lm_train_step(
         return shards, opt_state, loss
 
     state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
+    batch_spec = (P((dp_axis, ep_axis)) if sp_axis is None
+                  else P((dp_axis, ep_axis), sp_axis))
     sharded = C.smap(step, mesh,
-                     in_specs=(specs, state_specs,
-                               P((dp_axis, ep_axis))),
+                     in_specs=(specs, state_specs, batch_spec),
                      out_specs=(specs, state_specs, P()))
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 

@@ -158,10 +158,10 @@ def make_tp_train_step(
             loss, grads = jax.value_and_grad(
                 lambda p: base_loss(p, batch, cfg))(shards)
         with scope("loss_mean"):
-            # tp ranks hold identical losses; the tp-mean re-establishes
-            # replication for the P() out_spec explicitly.
-            for ax in rep_axes + [tp_axis]:
-                loss = C.all_reduce(loss, ax, mean=True)
+            # one fused mean over every axis (tp ranks hold identical
+            # losses; including tp re-establishes replication for the
+            # P() out_spec explicitly).
+            loss = lax.pmean(loss, tuple(rep_axes + [tp_axis]))
         with scope("grad_sync"):
             grads = jax.tree.map(
                 sync_grad, grads, specs,
