@@ -70,8 +70,13 @@ class TransformerConfig:
     attention_impl: str = "xla"  # "xla" | "flash" | "ring"
     sp_axis: str | None = None  # mesh axis the sequence is sharded on
     # Ring q-chunk: bound each fold's fp32 score buffer to
-    # (B, n, ring_block_q, S_local); 0 = unchunked.  Must divide S_local.
+    # (B, n, ring_block_q, S_local); 0 = unchunked.  Must divide S_local
+    # (S_local/2 for the zigzag layout).
     ring_block_q: int = 0
+    # Ring KV layout: "contiguous" (rank-order chunks) or "zigzag"
+    # (balanced stripes — ~half the ring's score FLOPs; batches must be
+    # fed through parallel.sequence.zigzag_shuffle).
+    ring_layout: str = "contiguous"
     # Cross-entropy vocab chunk: None materializes full (B, S, vocab) fp32
     # logits (the reference's documented ~4 GB spikes, README.md:28-33);
     # an int streams the vocab through an online logsumexp in chunks of
@@ -94,7 +99,11 @@ class TransformerConfig:
     moe_ffn: int | None = None
     moe_capacity_factor: float = 2.0
     moe_aux_weight: float = 0.01
-    moe_dispatch: str = "sort"  # "sort" (fast) | "einsum" (oracle)
+    # "grouped" (GShard-style per-group one-hot matmuls — fastest on TPU,
+    # no gather/scatter) | "sort" (global-capacity sort dispatch) |
+    # "einsum" (whole-chunk one-hot oracle == grouped with one group).
+    moe_dispatch: str = "grouped"
+    moe_group_size: int = 128  # tokens per dispatch group ("grouped" only)
     ep_axis: str | None = None
 
     def __post_init__(self):
@@ -111,6 +120,8 @@ class TransformerConfig:
                 "attention_impl='ring' needs sp_axis set to the mesh axis "
                 "the sequence is sharded on, and must run inside shard_map "
                 "(see parallel.sequence.sp_config)")
+        if self.ring_layout not in ("contiguous", "zigzag"):
+            raise ValueError(f"unknown ring_layout {self.ring_layout!r}")
 
     @property
     def resolved_head_dim(self) -> int:
@@ -211,13 +222,17 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (x * w.astype(jnp.float32)).astype(dt)
 
 
-def _rope_tables(seq_len: int, head_dim: int, theta: float, offset=0):
+def _rope_tables(seq_len: int, head_dim: int, theta: float, offset=0,
+                 positions=None):
     """``offset`` (may be traced) shifts positions — under sequence
-    parallelism each device's chunk starts at rank · S_local."""
+    parallelism each device's chunk starts at rank · S_local.
+    ``positions`` overrides with an explicit (seq_len,) global-position
+    array (zigzag layout: the chunk is two non-adjacent stripes)."""
     inv_freq = 1.0 / theta ** (jnp.arange(0, head_dim, 2,
                                           dtype=jnp.float32) / head_dim)
-    pos = offset + jnp.arange(seq_len, dtype=jnp.float32)
-    ang = pos[:, None] * inv_freq[None, :]
+    if positions is None:
+        positions = offset + jnp.arange(seq_len, dtype=jnp.float32)
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
     return jnp.cos(ang), jnp.sin(ang)
 
 
@@ -317,7 +332,8 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     elif cfg.attention_impl == "ring":  # sp_axis validated in __post_init__
         from ..ops.ring_attention import ring_attention
         attn = ring_attention(q, k, v, cfg.sp_axis, scale=scale,
-                              block_q=cfg.ring_block_q or None)
+                              block_q=cfg.ring_block_q or None,
+                              layout=cfg.ring_layout)
     else:
         attn = _attention_xla(q, k, v, scale).astype(x.dtype)
     from jax.ad_checkpoint import checkpoint_name
@@ -342,6 +358,7 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
                            axis=cfg.ep_axis,
                            capacity_factor=cfg.moe_capacity_factor,
                            dispatch=cfg.moe_dispatch,
+                           group_size=cfg.moe_group_size,
                            matmul_precision=cfg.matmul_precision)
     else:
         mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
@@ -407,10 +424,16 @@ def hidden_states(params: dict, input_ids: jax.Array,
     apply_layer = layer_body or _layer_body
     x = params["embed"].astype(cfg.dtype)[input_ids]
     # Under sequence parallelism S is the LOCAL chunk; RoPE positions and
-    # the causal structure use the global position offset of this rank.
-    offset = lax.axis_index(cfg.sp_axis) * S if cfg.sp_axis else 0
-    cos, sin = _rope_tables(S, cfg.resolved_head_dim, cfg.rope_theta,
-                            offset)
+    # the causal structure use this rank's GLOBAL positions — an offset
+    # for contiguous chunks, the stripe-pair position map for zigzag.
+    if cfg.sp_axis and cfg.ring_layout == "zigzag":
+        from ..ops.ring_attention import zigzag_positions
+        cos, sin = _rope_tables(S, cfg.resolved_head_dim, cfg.rope_theta,
+                                positions=zigzag_positions(cfg.sp_axis, S))
+    else:
+        offset = lax.axis_index(cfg.sp_axis) * S if cfg.sp_axis else 0
+        cos, sin = _rope_tables(S, cfg.resolved_head_dim, cfg.rope_theta,
+                                offset)
     flags = _rope_flags(cfg)
 
     def body(carry, scanned):

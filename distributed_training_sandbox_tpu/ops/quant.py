@@ -11,7 +11,13 @@ pieces, mirroring torchao's roles:
   * `int8_matmul`: XLA path (``lax.dot_general`` with int32 accumulation);
   * `int8_matmul_pallas`: the same contraction as a hand-tiled **Pallas
     kernel** with the dequant fused into the epilogue — the repo's
-    native/kernel-level component (runs in interpreter mode off-TPU);
+    native/kernel-level component (runs in interpreter mode off-TPU).
+    VERDICT: measured end-to-end twice (r2 and r3, flagship 3B-L8
+    seq 8192: 68.9 vs 74.7 TFLOPS/dev in r3) the hand-tiled kernel is
+    ~8-9% BEHIND XLA's own int8 dot + fused quantize epilogue, across a
+    block-size sweep.  XLA won; the kernel stays as the from-scratch
+    teaching artifact and `"int8"` (the XLA path) is the production
+    precision;
   * `quantized_dense`: straight-through-estimator linear layer for
     training (forward int8, backward bf16) — what Float8Linear does;
   * `quantized_all_gather`: gather int8 shards + scales and dequantize

@@ -16,11 +16,27 @@ verified against the monolithic fp32 reference in tests — not an
 approximation.  Numerics: scores and the (m, l, o) accumulator run in
 fp32 regardless of input dtype (the same policy as ``_attention_xla``).
 
-Causal note: with naive contiguous sharding, later ranks do more useful
-work per hop than earlier ranks (rank 0 masks everything but its own
-block).  The program is SPMD so the wall-clock cost is the full ring
-either way; zigzag/striped layouts that rebalance this are a known
-refinement and deliberately out of scope here.
+Causal note — two layouts:
+
+  * "contiguous" (default): device r holds global rows
+    [r·S_local, (r+1)·S_local).  Simple, but causally imbalanced: every
+    hop computes a full S_local × S_local score block and then masks it
+    (rank 0 masks everything but its own block) — at sp=D, ~half of all
+    ring-hop score FLOPs are computed-then-discarded.
+  * "zigzag": the global sequence is cut into 2D stripes of width
+    W = S_local/2 and device r holds stripes (r, 2D−1−r) — an early and
+    a late stripe.  Then for every REMOTE hop exactly two of the four
+    stripe-pair products are visible, and both are FULLY visible (no
+    mask): q_late × k_early always, plus q_early × k_early when
+    src < my else q_late × k_late.  Per-hop useful work is uniform
+    across ranks and the ring computes ~half the score FLOPs of the
+    contiguous layout — the standard striped/zigzag rebalance (Llama-3
+    context parallelism; zigzag ring attention).  Only the local block
+    (t = 0) needs a mask, built from global stripe positions.
+
+Zigzag requires the DATA laid out in stripe order — see
+``parallel.sequence.zigzag_shuffle`` (loss means are permutation-
+invariant, so training only needs ids/labels shuffled identically).
 """
 
 from __future__ import annotations
@@ -40,20 +56,33 @@ def _block_scores(q, k, scale):
 
 def ring_attention(q, k, v, axis_name: str, *, scale: float,
                    causal: bool = True,
-                   block_q: int | None = None) -> jax.Array:
+                   block_q: int | None = None,
+                   layout: str = "contiguous") -> jax.Array:
     """Attention over a sequence sharded on ``axis_name`` (shard_map only).
 
-    q, k, v: (B, S_local, n_heads, head_dim) — this device's contiguous
-    chunk of the global sequence, chunks laid out in rank order.  GQA
-    inputs (n_kv < n_q) are repeated up front.  Returns (B, S_local,
-    n_heads, head_dim) in q's dtype.
+    q, k, v: (B, S_local, n_heads, head_dim) — this device's chunk of
+    the global sequence: rank-order contiguous for ``layout=
+    "contiguous"``, stripe pairs (my, 2D−1−my) for ``layout="zigzag"``
+    (see module docstring; data must be pre-shuffled with
+    ``parallel.sequence.zigzag_shuffle``).  GQA inputs (n_kv < n_q) are
+    repeated per block.  Returns (B, S_local, n_heads, head_dim) in q's
+    dtype.
 
     ``block_q``: chunk the query rows of each fold so the fp32 score
     buffer is (B, n, block_q, S_local) instead of (B, n, S_local,
     S_local) — the flash-style memory bound that makes long LOCAL chunks
     viable (at S_local=8k, nq=16 the unchunked buffer is 4 GB fp32 per
-    hop).  Must divide S_local; None/0 = unchunked.
+    hop).  Must divide S_local (S_local/2 for zigzag); None/0 =
+    unchunked.
     """
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError("zigzag layout only pays off for causal "
+                             "attention — use layout='contiguous'")
+        return _ring_zigzag(q, k, v, axis_name, scale=scale,
+                            block_q=block_q)
+    if layout != "contiguous":
+        raise ValueError(f"unknown ring layout {layout!r}")
     n_dev = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Sq, nq, hd = q.shape
@@ -64,6 +93,10 @@ def ring_attention(q, k, v, axis_name: str, *, scale: float,
     if block_q is not None and block_q <= 0:
         raise ValueError(f"block_q={block_q} must be a positive divisor "
                          f"of S_local={Sq} (or None)")
+    if block_q and block_q > Sq:
+        raise ValueError(f"block_q={block_q} exceeds S_local={Sq}; pass "
+                         f"block_q=None (or <= S_local) — silently running "
+                         f"unchunked would hide a misconfigured sp setup")
     Cq = block_q if block_q and block_q < Sq else Sq
     if Sq % Cq:
         raise ValueError(f"block_q={block_q} must divide S_local={Sq}")
@@ -147,4 +180,152 @@ def ring_attention(q, k, v, axis_name: str, *, scale: float,
         l = l.transpose(1, 2, 0, 3, 4).reshape(B, nq, Sq, 1)
         o = o.transpose(1, 0, 2, 3, 4).reshape(B, Sq, nq, hd)
     l = jnp.where(l == 0.0, 1.0, l)  # rows with no visible keys (unused)
+    return (o / l.swapaxes(1, 2)).astype(q.dtype)
+
+
+def zigzag_positions(axis_name: str, s_local: int) -> jax.Array:
+    """Global token positions of this rank's zigzag chunk (stripe ``my``
+    then stripe ``2D−1−my``) — what RoPE and the local causal mask see."""
+    n_dev = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    w = s_local // 2
+    ar = jnp.arange(w)
+    return jnp.concatenate([my * w + ar, (2 * n_dev - 1 - my) * w + ar])
+
+
+def _ring_zigzag(q, k, v, axis_name: str, *, scale: float,
+                 block_q: int | None = None) -> jax.Array:
+    """Causal ring attention over the zigzag/striped layout.
+
+    Per remote hop: two FULLY-VISIBLE W×W products (module docstring) —
+    no computed-then-masked scores; which second product runs is a
+    ``lax.cond`` on src < my, so only the needed branch executes.  The
+    local block (t = 0) is one position-masked product over the whole
+    chunk.  Accumulators (m, l, o) span the full local S and products
+    read/write their stripe's half via static slices."""
+    n_dev = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Sq, nq, hd = q.shape
+    if Sq % 2:
+        raise ValueError(f"zigzag needs an even local chunk, got {Sq}")
+    W = Sq // 2
+    nkv = k.shape[2]
+    rep = nq // nkv
+    if block_q is not None and block_q <= 0:
+        raise ValueError(f"block_q={block_q} must be a positive divisor "
+                         f"of S_local/2={W} (or None)")
+    if block_q and block_q > W:
+        raise ValueError(f"block_q={block_q} exceeds the zigzag stripe "
+                         f"width S_local/2={W}")
+    Cq = block_q if block_q and block_q < W else W
+    if W % Cq:
+        raise ValueError(f"block_q={block_q} must divide S_local/2={W}")
+    qf = q.astype(jnp.float32)
+    pos = zigzag_positions(axis_name, Sq)
+
+    def merge(qc, k_blk, v_blk, m, l, o, qpos=None, kpos=None):
+        """Online-softmax fold of one KV block into one q chunk's
+        (m, l, o); positions given -> causal mask, None -> fully
+        visible.  qc/o: (B, P, n, hd); m/l: (B, n, P, 1)."""
+        s = _block_scores(qc, k_blk, scale)
+        if qpos is not None:
+            vis = kpos[None, :] <= qpos[:, None]
+            s = jnp.where(vis[None, None], s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(m_new <= _NEG_INF, 0.0, p)
+        corr = jnp.where(m <= _NEG_INF, 0.0, jnp.exp(m - m_new))
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr.swapaxes(1, 2) + jnp.einsum("bnqk,bknh->bqnh", p,
+                                                 v_blk)
+        return m_new, l, o
+
+    def product(qp, k_blk, v_blk, m, l, o, qpos=None, kpos=None):
+        """``merge`` chunked over q rows by Cq (flash-style score-buffer
+        bound).  qp: (B, P, nq, hd) with Cq | P."""
+        P = qp.shape[1]
+        if Cq >= P:
+            return merge(qp, k_blk, v_blk, m, l, o, qpos, kpos)
+
+        def body(carry, c):
+            m, l, o = carry
+            r0 = c * Cq
+            qc = lax.dynamic_slice_in_dim(qp, r0, Cq, 1)
+            mc = lax.dynamic_slice_in_dim(m, r0, Cq, 2)
+            lc = lax.dynamic_slice_in_dim(l, r0, Cq, 2)
+            oc = lax.dynamic_slice_in_dim(o, r0, Cq, 1)
+            qpc = (lax.dynamic_slice_in_dim(qpos, r0, Cq, 0)
+                   if qpos is not None else None)
+            mc, lc, oc = merge(qc, k_blk, v_blk, mc, lc, oc, qpc, kpos)
+            return (lax.dynamic_update_slice_in_dim(m, mc, r0, 2),
+                    lax.dynamic_update_slice_in_dim(l, lc, r0, 2),
+                    lax.dynamic_update_slice_in_dim(o, oc, r0, 1)), None
+
+        (m, l, o), _ = lax.scan(body, (m, l, o), jnp.arange(P // Cq))
+        return m, l, o
+
+    def rep_kv(k_blk, v_blk):
+        k_blk = k_blk.astype(jnp.float32)
+        v_blk = v_blk.astype(jnp.float32)
+        if rep != 1:
+            k_blk = jnp.repeat(k_blk, rep, axis=2)
+            v_blk = jnp.repeat(v_blk, rep, axis=2)
+        return k_blk, v_blk
+
+    m = jnp.full((B, nq, Sq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, nq, Sq, 1), jnp.float32)
+    o = jnp.zeros((B, Sq, nq, hd), jnp.float32)
+
+    # t = 0: the local block, position-masked (covers both stripes' diag
+    # sub-blocks and the always-visible q_late × k_early corner).
+    kf, vf = rep_kv(k, v)
+    m, l, o = product(qf, kf, vf, m, l, o, pos, pos)
+
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def lower(mlo, half, vals):
+        """Write (m, l, o) values into one stripe's half: 0 = early."""
+        m, l, o = mlo
+        mv, lv, ov = vals
+        r0 = 0 if half == 0 else W
+        return (lax.dynamic_update_slice_in_dim(m, mv, r0, 2),
+                lax.dynamic_update_slice_in_dim(l, lv, r0, 2),
+                lax.dynamic_update_slice_in_dim(o, ov, r0, 1))
+
+    def lift(mlo, half):
+        m, l, o = mlo
+        r0 = 0 if half == 0 else W
+        return (lax.dynamic_slice_in_dim(m, r0, W, 2),
+                lax.dynamic_slice_in_dim(l, r0, W, 2),
+                lax.dynamic_slice_in_dim(o, r0, W, 1))
+
+    def fold(carry, t):
+        k_blk, v_blk, m, l, o = carry
+        k_blk, v_blk = jax.tree.map(
+            lambda x: lax.ppermute(x, axis_name, perm), (k_blk, v_blk))
+        src = (my - t) % n_dev
+        kf, vf = rep_kv(k_blk, v_blk)
+        ka, va = kf[:, :W], vf[:, :W]
+        kb, vb = kf[:, W:], vf[:, W:]
+        # product 1: q_late × k_early — visible for every src ≠ my.
+        mlo = lower((m, l, o), 1,
+                    product(qf[:, W:], ka, va, *lift((m, l, o), 1)))
+        # product 2: src < my -> q_early × k_early; src > my ->
+        # q_late × k_late.  Both fully visible; one branch executes.
+        def early(mlo):
+            return lower(mlo, 0,
+                         product(qf[:, :W], ka, va, *lift(mlo, 0)))
+
+        def late(mlo):
+            return lower(mlo, 1,
+                         product(qf[:, W:], kb, vb, *lift(mlo, 1)))
+
+        m, l, o = lax.cond(src < my, early, late, mlo)
+        return (k_blk, v_blk, m, l, o), None
+
+    if n_dev > 1:
+        (_, _, m, l, o), _ = lax.scan(fold, (k, v, m, l, o),
+                                      jnp.arange(1, n_dev))
+    l = jnp.where(l == 0.0, 1.0, l)
     return (o / l.swapaxes(1, 2)).astype(q.dtype)

@@ -41,6 +41,7 @@ from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models.mlp import mlp_apply, mlp_apply_stage
 from ..utils.memory import device_memory_stats, MB
@@ -67,37 +68,58 @@ class PipelineStage:
 
     def __init__(self, stage_params, device: jax.Device,
                  apply_fn: Callable = mlp_apply, is_last: bool = False,
-                 loss_fn: Callable | None = None):
+                 loss_fn: Callable | None = None, has_aux: bool = False,
+                 aux_weight: float = 0.0):
         self.device = device
         self.params = jax.device_put(stage_params, device)
         self.is_last = is_last
-        apply = apply_fn
+        self.aux_weight = aux_weight if has_aux else 0.0
+        # Uniform internal contract: the stage forward yields (out, aux)
+        # where aux is this stage's additive side loss (the MoE
+        # load-balance sum over its layers; constant 0 for dense stages).
+        # The schedulers feed the aux cotangent (aux_weight / n_micro)
+        # straight into each stage's vjp — the aux gradient is local to
+        # the stage, so threading it across stages isn't needed; only the
+        # scalar VALUES travel (for the reported loss).
+        if has_aux:
+            apply = apply_fn
+        else:
+            apply = lambda p, x: (apply_fn(p, x),  # noqa: E731
+                                  jnp.zeros((), jnp.float32))
         loss2 = loss_fn or (lambda out, y: jnp.mean((out - y) ** 2))
         # a loss may also take the stage params (3-arg form) — how the
         # transformer's last stage reaches its unembedding for the
         # streamed-vocab loss.
         import inspect
-        params_ = inspect.signature(loss2).parameters.values()
-        required_pos = sum(
-            1 for q in params_
-            if q.kind in (q.POSITIONAL_ONLY, q.POSITIONAL_OR_KEYWORD)
-            and q.default is q.empty)
+        try:
+            params_ = inspect.signature(loss2).parameters.values()
+            required_pos = sum(
+                1 for q in params_
+                if q.kind in (q.POSITIONAL_ONLY, q.POSITIONAL_OR_KEYWORD)
+                and q.default is q.empty)
+        except (ValueError, TypeError):
+            # builtins / some transformed callables have no inspectable
+            # signature — default to the common 2-arg form.
+            required_pos = 2
         if required_pos >= 3:
             loss = loss2
         else:
             loss = lambda out, y, p: loss2(out, y)  # noqa: E731
 
-        def fwd(p, x):
-            return apply(p, x)
+        aux_w = self.aux_weight
 
-        def bwd(p, x, gout):
+        def fwd(p, x):
+            return apply(p, x)           # (out, aux)
+
+        def bwd(p, x, gout, aux_ct):
             _, vjp = jax.vjp(apply, p, x)
-            gp, gx = vjp(gout)
+            gp, gx = vjp((gout, aux_ct))
             return gp, gx
 
         def last_fwd_bwd(p, x, y, inv_n_micro):
             def scaled(p, x):
-                return loss(apply(p, x), y, p) * inv_n_micro
+                out, aux = apply(p, x)
+                return (loss(out, y, p) + aux_w * aux) * inv_n_micro
             (l, (gp, gx)) = jax.value_and_grad(scaled, argnums=(0, 1))(p, x)
             return l, gp, gx
 
@@ -111,6 +133,10 @@ class PipelineStage:
         # observable form of 1F1B's ~n_stages vs GPipe's ~n_micro peak
         # (1f1b.py:4-11) on substrates without allocator stats.
         self.max_stored = 0
+        # example input/label shapes, captured by the schedulers for
+        # memory_plan_mb's compile-time analysis
+        self.input_sds = None
+        self.label_sds = None
 
     def accumulate(self, gp):
         if self.grad_acc is None:
@@ -128,6 +154,32 @@ class PipelineStage:
 
     def peak_memory_mb(self) -> float:
         return device_memory_stats(self.device)["peak_bytes_in_use"] / MB
+
+    def memory_plan_mb(self) -> float:
+        """Compile-time peak estimate for this stage's backward kernel
+        (vjp = forward + backward in one program): arguments (params +
+        stored activation) + XLA temp buffers.  The substrate-honest
+        number on backends whose allocator exposes no runtime stats
+        (``compiled.memory_analysis()``, as scripts/memory_waterline.py
+        uses) — 0.0 when no microbatch has been seen yet."""
+        if getattr(self, "input_sds", None) is None:
+            return 0.0
+        try:
+            x = self.input_sds
+            if self.is_last:
+                c = self.last_fwd_bwd.lower(
+                    self.params, x, self.label_sds,
+                    jax.ShapeDtypeStruct((), jnp.float32)).compile()
+            else:
+                out, _aux = jax.eval_shape(self.fwd, self.params, x)
+                c = self.bwd.lower(
+                    self.params, x, out,
+                    jax.ShapeDtypeStruct((), jnp.float32)).compile()
+            ma = c.memory_analysis()
+            return (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+                    + ma.output_size_in_bytes) / MB
+        except Exception:
+            return 0.0
 
 
 def build_pipeline(params: list, n_stages: int,
@@ -174,11 +226,11 @@ def build_transformer_pipeline(params: dict, cfg, n_stages: int,
 
     from ..models import transformer as T
 
-    if cfg.n_experts:
+    if cfg.n_experts and cfg.ep_axis is not None:
         raise ValueError(
-            "build_transformer_pipeline does not thread the MoE "
-            "load-balance aux loss across stages yet — stage a dense "
-            "config (n_experts=0)")
+            "MoE×PP stages run one process per stage — experts must be "
+            "stage-local (cfg.ep_axis=None); shard experts with the "
+            "dp×ep step instead (parallel.expert.make_moe_lm_train_step)")
     L = cfg.num_hidden_layers
     if n_stages > L:
         raise ValueError(f"n_stages={n_stages} exceeds "
@@ -214,17 +266,19 @@ def build_transformer_pipeline(params: dict, cfg, n_stages: int,
 
             def body(carry, scanned):
                 layer, use_rope = scanned
-                h, _aux = T._layer_body(carry, layer, cfg=cfg, cos=cos,
-                                        sin=sin, use_rope=use_rope)
-                return h, None
+                h, aux = T._layer_body(carry, layer, cfg=cfg, cos=cos,
+                                       sin=sin, use_rope=use_rope)
+                return h, aux
 
             if cfg.remat:
                 body = jax.checkpoint(
                     body, prevent_cse=False,
                     policy=T.resolve_remat_policy(cfg))
-            x, _ = jax.lax.scan(body, x, (p["layers"], _flags))
+            x, auxs = jax.lax.scan(body, x, (p["layers"], _flags))
             if _last:
-                return T.rms_norm(x, p["final_norm"], cfg.rms_norm_eps)
+                x = T.rms_norm(x, p["final_norm"], cfg.rms_norm_eps)
+            if cfg.n_experts:   # stage aux = its layers' balance losses
+                return x, jnp.sum(auxs)
             return x
 
         def lm_xent(hidden, labels, p):
@@ -236,7 +290,9 @@ def build_transformer_pipeline(params: dict, cfg, n_stages: int,
 
         stages.append(PipelineStage(
             sp, devs[s % len(devs)], apply, is_last=last,
-            loss_fn=lm_xent if last else None))  # only last has lm_head
+            loss_fn=lm_xent if last else None,  # only last has lm_head
+            has_aux=bool(cfg.n_experts),
+            aux_weight=cfg.moe_aux_weight))
     return stages
 
 
@@ -270,16 +326,20 @@ def run_gpipe(stages: list[PipelineStage], x, y, n_micro: int = 4,
 
     # ---- all-forward phase, stage by stage (gpipe.py:92-115)
     acts_last: list = []
+    aux_terms: list = []   # non-last stages' weighted aux losses (device)
     for s, stage in enumerate(stages):
         while fwd_q[s]:
             xin = _to_stage(fwd_q[s].popleft(), stage)
             stored[s].append(xin)
+            stage.input_sds = jax.ShapeDtypeStruct(xin.shape, xin.dtype)
             stage.max_stored = max(stage.max_stored, len(stored[s]))
             if stage.is_last:
                 acts_last.append(xin)
             else:
-                out = stage.fwd(stage.params, xin)
+                out, aux = stage.fwd(stage.params, xin)
                 fwd_q[s + 1].append(out)
+                if stage.aux_weight:
+                    aux_terms.append(stage.aux_weight * inv * aux)
 
     # ---- all-backward phase, reverse microbatch order (gpipe.py:119-147)
     # losses stay device scalars until the end: a float() per microbatch
@@ -287,6 +347,7 @@ def run_gpipe(stages: list[PipelineStage], x, y, n_micro: int = 4,
     mb_losses = []
     for mb in reversed(range(n_micro)):
         yd = _to_stage(ys[mb], stages[-1])
+        stages[-1].label_sds = jax.ShapeDtypeStruct(yd.shape, yd.dtype)
         l, gp, gx = stages[-1].last_fwd_bwd(
             stages[-1].params, acts_last[mb], yd, inv)
         stages[-1].accumulate(gp)
@@ -295,12 +356,17 @@ def run_gpipe(stages: list[PipelineStage], x, y, n_micro: int = 4,
         for s in range(n_stages - 2, -1, -1):
             stage = stages[s]
             g = _to_stage(g, stage)
-            gp, g = stage.bwd(stage.params, stored[s][mb], g)
+            gp, g = stage.bwd(stage.params, stored[s][mb], g,
+                              jnp.float32(stage.aux_weight) * inv)
             stage.accumulate(gp)
 
     for stage in stages:
         stage.step(lr)
-    return float(jnp.sum(jnp.stack(mb_losses)))
+    loss = float(jnp.sum(jnp.stack(mb_losses)))
+    # earlier stages' weighted aux (the last stage's is inside l); the
+    # terms live on DIFFERENT stage devices, so sum on host, not stacked
+    loss += sum(float(a) for a in aux_terms)
+    return loss
 
 
 def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
@@ -335,6 +401,7 @@ def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
     stored: list[dict] = [dict() for _ in range(n_stages)]
 
     mb_losses = []
+    aux_terms: list = []
     ticks = n_micro + n_stages - 1
     for tick in range(ticks):
         for s, stage in enumerate(stages):
@@ -343,12 +410,17 @@ def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
                 mb, xin = fwd_q[s].popleft()
                 xin = _to_stage(xin, stage)
                 stored[s][mb] = xin
+                stage.input_sds = jax.ShapeDtypeStruct(xin.shape,
+                                                       xin.dtype)
                 stage.max_stored = max(stage.max_stored, len(stored[s]))
                 if stage.is_last:
                     # last stage backs-prop immediately (1f1b.py:130-131)
                     bwd_q[s].append((mb, None))
                 else:
-                    fwd_q[s + 1].append((mb, stage.fwd(stage.params, xin)))
+                    out, aux = stage.fwd(stage.params, xin)
+                    fwd_q[s + 1].append((mb, out))
+                    if stage.aux_weight:
+                        aux_terms.append(stage.aux_weight * inv * aux)
                 if schedule_trace is not None:
                     schedule_trace.append((tick, s, "fwd", mb))
             # one backward per tick per stage (1f1b.py:134-158)
@@ -357,11 +429,14 @@ def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
                 xin = stored[s].pop(mb)  # free the activation
                 if stage.is_last:
                     yd = _to_stage(ys[mb], stage)
+                    stage.label_sds = jax.ShapeDtypeStruct(yd.shape,
+                                                           yd.dtype)
                     l, gp, gx = stage.last_fwd_bwd(stage.params, xin, yd, inv)
                     mb_losses.append(l)
                 else:
                     gp, gx = stage.bwd(stage.params, xin,
-                                       _to_stage(gout, stage))
+                                       _to_stage(gout, stage),
+                                       jnp.float32(stage.aux_weight) * inv)
                 stage.accumulate(gp)
                 if s > 0:
                     bwd_q[s - 1].append((mb, gx))
@@ -374,12 +449,20 @@ def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
 
     for stage in stages:
         stage.step(lr)
-    return float(jnp.sum(jnp.stack(mb_losses)))
+    loss = float(jnp.sum(jnp.stack(mb_losses)))
+    # per-stage-device aux scalars: host sum (see run_gpipe note)
+    loss += sum(float(a) for a in aux_terms)
+    return loss
 
 
 @dataclass
 class PipeResult:
-    """JSON results schema twin of ``gpipe.py:205-218``."""
+    """JSON results schema twin of ``gpipe.py:205-218``, extended with
+    the substrate-honest memory pair: runtime allocator peaks when the
+    backend exposes them, plus ALWAYS the compile-time per-stage plan
+    (args + XLA temps of the stage's backward program) and the stored-
+    activation high-water mark — the observable GPipe-vs-1F1B story on
+    backends whose allocator reports nothing."""
     schedule: str
     final_loss: float
     avg_loss: float
@@ -388,6 +471,13 @@ class PipeResult:
     epochs_per_s: float
     peak_memory_mb: dict = field(default_factory=dict)
     total_peak_memory_mb: float = 0.0
+    # "allocator" when peak_memory_mb carries real runtime stats,
+    # "compiled_plan" when the allocator reports nothing there and the
+    # plan columns are the meaningful numbers.
+    memory_source: str = "allocator"
+    memory_plan_mb: dict = field(default_factory=dict)
+    max_stored_activations: dict = field(default_factory=dict)
+    activation_mb_per_microbatch: dict = field(default_factory=dict)
 
     def as_dict(self) -> dict:
         return asdict(self)
@@ -410,6 +500,14 @@ def train_pipeline(stages: list[PipelineStage], schedule: str,
             log(epoch, loss)
     total = time.perf_counter() - t0
     peaks = {f"device_{i}": s.peak_memory_mb() for i, s in enumerate(stages)}
+    plan = {f"device_{i}": round(s.memory_plan_mb(), 1)
+            for i, s in enumerate(stages)}
+    act_mb = {
+        f"device_{i}":
+            round(int(np.prod(s.input_sds.shape))
+                  * jnp.dtype(s.input_sds.dtype).itemsize / MB, 3)
+            if s.input_sds is not None else 0.0
+        for i, s in enumerate(stages)}
     return PipeResult(
         schedule=schedule,
         final_loss=losses[-1],
@@ -419,4 +517,10 @@ def train_pipeline(stages: list[PipelineStage], schedule: str,
         epochs_per_s=num_epochs / total,
         peak_memory_mb=peaks,
         total_peak_memory_mb=sum(peaks.values()),
+        memory_source=("allocator" if any(peaks.values())
+                       else "compiled_plan"),
+        memory_plan_mb=plan,
+        max_stored_activations={f"device_{i}": s.max_stored
+                                for i, s in enumerate(stages)},
+        activation_mb_per_microbatch=act_mb,
     )

@@ -36,16 +36,58 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh
 
 from ..models import transformer as T
 from .fsdp import make_fsdp_train_step
 
 
-def sp_config(cfg: T.TransformerConfig, sp_axis: str = "sp"
-              ) -> T.TransformerConfig:
-    """The config switched to ring attention over ``sp_axis``."""
-    return dataclasses.replace(cfg, attention_impl="ring", sp_axis=sp_axis)
+def sp_config(cfg: T.TransformerConfig, sp_axis: str = "sp",
+              layout: str = "contiguous") -> T.TransformerConfig:
+    """The config switched to ring attention over ``sp_axis``.
+    ``layout="zigzag"`` selects the balanced striped layout (~half the
+    ring's score FLOPs; see ``ops/ring_attention.py``) — feed batches
+    through ``zigzag_shuffle`` then."""
+    return dataclasses.replace(cfg, attention_impl="ring", sp_axis=sp_axis,
+                               ring_layout=layout)
+
+
+def _zigzag_perm(n_dev: int) -> np.ndarray:
+    """Stripe order giving device r stripes (r, 2D−1−r) under contiguous
+    equal sharding: [0, 2D−1, 1, 2D−2, ...]."""
+    return np.array([s for r in range(n_dev)
+                     for s in (r, 2 * n_dev - 1 - r)])
+
+
+def zigzag_shuffle(x, n_dev: int, axis: int = 1):
+    """Reorder a GLOBAL sequence dim into zigzag stripe order, so a plain
+    contiguous P(sp) sharding lands stripes (r, 2D−1−r) on device r.
+    Apply to input_ids and labels identically — token-mean losses are
+    permutation-invariant, so training semantics are unchanged."""
+    S = x.shape[axis]
+    if S % (2 * n_dev):
+        raise ValueError(f"sequence length {S} must divide into "
+                         f"2·{n_dev} zigzag stripes")
+    w = S // (2 * n_dev)
+    shape = x.shape
+    stripes = x.reshape(*shape[:axis], 2 * n_dev, w, *shape[axis + 1:])
+    out = jnp.take(stripes, _zigzag_perm(n_dev), axis=axis)
+    return out.reshape(shape)
+
+
+def zigzag_unshuffle(x, n_dev: int, axis: int = 1):
+    """Inverse of ``zigzag_shuffle`` (restore natural sequence order)."""
+    S = x.shape[axis]
+    if S % (2 * n_dev):
+        raise ValueError(f"sequence length {S} must divide into "
+                         f"2·{n_dev} zigzag stripes")
+    w = S // (2 * n_dev)
+    shape = x.shape
+    stripes = x.reshape(*shape[:axis], 2 * n_dev, w, *shape[axis + 1:])
+    out = jnp.take(stripes, np.argsort(_zigzag_perm(n_dev)), axis=axis)
+    return out.reshape(shape)
 
 
 def make_sp_train_step(

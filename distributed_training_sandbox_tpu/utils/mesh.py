@@ -63,6 +63,14 @@ def setup_distributed(
     if num_processes is None and env_procs is not None:
         num_processes = int(env_procs)
     if num_processes is not None and num_processes > 1:
+        plats = str(jax.config.jax_platforms
+                    or os.environ.get("JAX_PLATFORMS", ""))
+        if "cpu" in plats:
+            # CPU cross-process collectives need an explicit backend;
+            # gloo ships with jaxlib (the reference's gloo-on-CPU-ranks
+            # mode, modal_utils.py / SURVEY.md §7.1).
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -66,11 +66,13 @@ def main(schedule: str, argv=None):
         stages = build_transformer_pipeline(params, mcfg, args.n_stages)
 
         def make_batch(epoch):
+            # packed-window contract (inputs = w[:-1], labels = w[1:]),
+            # matching lm_loss everywhere else.
             k = jax.random.fold_in(key, epoch)
-            ids = jax.random.randint(
-                k, (cfg.batch_size, cfg.sequence_length), 0,
+            w = jax.random.randint(
+                k, (cfg.batch_size, cfg.sequence_length + 1), 0,
                 mcfg.vocab_size)
-            return ids, jnp.roll(ids, -1, axis=1)
+            return w[:, :-1], w[:, 1:]
     devs = [str(s.device) for s in stages]
     print(f"[{schedule}] model={args.model} stages={args.n_stages} "
           f"micro={args.n_micro} devices={devs}")
@@ -91,9 +93,7 @@ def main(schedule: str, argv=None):
     if prof:
         prof.stop()
 
-    out = result.as_dict()
-    out["max_stored_activations"] = {
-        f"stage_{i}": s.max_stored for i, s in enumerate(stages)}
+    out = result.as_dict()   # incl. max_stored_activations + memory plan
     print(f"[{schedule}] {json.dumps(out)}")
     if args.results_file:
         Path(args.results_file).write_text(json.dumps(out, indent=2))

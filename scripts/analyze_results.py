@@ -32,6 +32,8 @@ def _load_json_rows(dirname: str, pattern: str = "*.json") -> list[dict]:
     rows = []
     for f in sorted(glob.glob(f"{dirname}/{pattern}")):
         d = json.load(open(f))
+        if isinstance(d, dict) and "rows" in d:   # wrapped artifact
+            d = d["rows"]
         rows.extend(d if isinstance(d, list) else [d])
     return rows
 
@@ -124,24 +126,43 @@ def longctx_table(rows: list[dict]) -> str:
     return "\n".join(out)
 
 
+def moe_drop_note(dirname: str) -> str:
+    """Grouped-dispatch drop rates from the bench artifact (written by
+    ``moe_bench.measure_drop_rates`` next to the rows it describes)."""
+    drops = []
+    for f in sorted(glob.glob(f"{dirname}/*.json")):
+        d = json.load(open(f))
+        if isinstance(d, dict):
+            drops += d.get("drop_rates_at_init", [])
+    if not drops:
+        return ""
+    parts = [f"cf{d['capacity_factor']} "
+             f"{100 * d['drop_fraction']:.1f}%" for d in drops]
+    return ("  Grouped drop rates at init (group "
+            f"{drops[0]['group_size']}): " + ", ".join(parts) + ".")
+
+
 def moe_table(rows: list[dict]) -> str:
     if not rows:
         return "_no MoE benchmark found_\n"
-    out = ["| model | platform | seq | batch | dispatch | precision "
+    out = ["| model | platform | seq | batch | dispatch | cf | precision "
            "| tok/s | TFLOPS/device (active) |",
-           "|---|---|---|---|---|---|---|---|"]
+           "|---|---|---|---|---|---|---|---|---|"]
     for r in rows:
+        if "tflops_per_device" not in r and "error" not in r:
+            continue   # e.g. phase-breakdown / drop-rate side artifacts
         c = r.get("config", {})
         disp = c.get("moe_dispatch", "?")
+        cf = c.get("moe_capacity_factor", 2.0)
         prec = c.get("matmul_precision", "bf16")
         plat = r.get("platform", "?")
         if "error" in r:
             out.append(f"| {r['model']} | {plat} | {r['seq_len']} | "
-                       f"{r['batch']} | {disp} | {prec} | — | "
+                       f"{r['batch']} | {disp} | {cf} | {prec} | — | "
                        f"{r['error'][:50]} |")
         else:
             out.append(f"| {r['model']} | {plat} | {r['seq_len']} | "
-                       f"{r['batch']} | {disp} | {prec} | "
+                       f"{r['batch']} | {disp} | {cf} | {prec} | "
                        f"{r['tokens_per_sec']:.0f} | "
                        f"{r['tflops_per_device']:.2f} |")
     out.append("")
@@ -207,8 +228,12 @@ def main(argv=None):
         "## MoE transformer (`scripts/moe_bench.py`)",
         "",
         "Switch-MoE flagship geometry (8 experts × 2752 ffn — the dense "
-        "3B-L8 MLP split 4-ways active), FSDP train step, sort-based vs "
-        "one-hot-einsum dispatch.  TFLOPS counts ACTIVE (top-1) FLOPs.",
+        "3B-L8 MLP split 4-ways active), FSDP train step.  Dispatch "
+        "modes: grouped (per-group one-hot matmuls, r3 default) vs "
+        "sort (global-capacity gather) vs whole-chunk einsum oracle; "
+        "cf = capacity factor.  Dense same-model rows for comparison: "
+        "the FSDP knob matrix above.  TFLOPS counts ACTIVE (top-1) "
+        "FLOPs." + moe_drop_note(args.moe_dir),
         "",
         moe_table(moe),
     ]

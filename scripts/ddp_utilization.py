@@ -1,0 +1,179 @@
+"""DDP batch-size utilization sweep — twin of the reference's one
+committed experiment table (``/root/reference/DDP/EXPERIMENTS.md:9-12``:
+GPU utilization / SM efficiency / occupancy at bs 8/32/64/128, with the
+bs-128 OOM edge).
+
+The TPU-honest columns: step time, samples/s, achieved model
+TFLOPS/device, MFU against the chip's bf16 peak, and the compile-time
+memory plan (``compiled.memory_analysis()`` — the allocator on this
+substrate exposes no runtime stats).  The sweep keeps doubling the batch
+past the reference's grid until the step fails to compile/run, recording
+the OOM edge the same way the reference's bs-128 row does.
+
+    python scripts/ddp_utilization.py [--model smollm3-350m] [--seq 128]
+
+Writes ``ddp_results/utilization_<platform>.json`` and appends the
+markdown table to EXPERIMENTS.md (idempotent: replaces its own section).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# v5e TensorCore peak (bf16); used only for the MFU column.
+PEAK_BF16 = {"tpu": 197e12}
+
+SECTION = "## DDP batch-size utilization sweep"
+
+
+def run_one(bs: int, seq: int, mcfg, mesh, num_steps: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_training_sandbox_tpu.models import (
+        classification_loss, init_classifier_params)
+    from distributed_training_sandbox_tpu.parallel import (
+        broadcast_params, make_ddp_train_step, optim)
+    from distributed_training_sandbox_tpu.ops import smap
+    from distributed_training_sandbox_tpu.utils.flops import (
+        get_model_flops_per_token)
+    from jax.sharding import PartitionSpec as P
+
+    params = init_classifier_params(jax.random.PRNGKey(0), mcfg)
+    params = jax.jit(smap(lambda p: broadcast_params(p, "dp"),
+                          mesh, P(), P()))(params)
+    opt_state = optim.sgd_init(params)
+    step = make_ddp_train_step(
+        functools.partial(classification_loss, cfg=mcfg),
+        lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3), mesh, "dp")
+
+    key = jax.random.PRNGKey(1)
+    batch = {
+        "input_ids": jax.random.randint(key, (bs, seq), 0,
+                                        mcfg.vocab_size, jnp.int32),
+        "attention_mask": jnp.ones((bs, seq), jnp.int32),
+        "labels": jnp.zeros((bs,), jnp.int32),
+    }
+
+    # compile-time memory plan of the whole jitted step
+    lowered = step.lower(params, opt_state, batch)
+    compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    plan_gb = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+               + ma.output_size_in_bytes) / 2**30
+
+    for _ in range(2):   # compile + settle
+        params, opt_state, loss = step(params, opt_state, batch)
+        np.asarray(loss)
+    t0 = time.perf_counter()
+    for _ in range(num_steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    np.asarray(loss)
+    dt = (time.perf_counter() - t0) / num_steps
+
+    ws = int(mesh.devices.size)
+    ft = get_model_flops_per_token(mcfg, seq)
+    tflops_dev = bs * seq * ft / dt / ws / 1e12
+    peak = PEAK_BF16.get(jax.devices()[0].platform)
+    return {
+        "batch_size": bs, "seq": seq, "step_ms": round(dt * 1e3, 1),
+        "samples_per_sec": round(bs / dt, 1),
+        "tokens_per_sec": round(bs * seq / dt, 1),
+        "tflops_per_device": round(tflops_dev, 2),
+        "mfu_pct": round(100 * tflops_dev * 1e12 / peak, 1) if peak
+        else None,
+        "memory_plan_gb": round(plan_gb, 2),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="smollm3-350m")
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--num-steps", type=int, default=10)
+    p.add_argument("--max-batch", type=int, default=4096)
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--out-dir", default="ddp_results")
+    args = p.parse_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    import jax
+    from distributed_training_sandbox_tpu.models import (
+        MODEL_REGISTRY, transformer as T)
+    from distributed_training_sandbox_tpu.utils import make_mesh
+
+    mcfg = getattr(T, MODEL_REGISTRY[args.model])
+    mesh = make_mesh()
+    platform = jax.devices()[0].platform
+    rows = []
+    bs_grid = [8, 32, 64, 128]      # the reference's grid...
+    nxt = 256                       # ...then double to find the edge
+    while bs_grid:
+        bs = bs_grid.pop(0)
+        try:
+            r = run_one(bs, args.seq, mcfg, mesh, args.num_steps)
+            rows.append(r)
+            print(f"[ddp-util] {r}", flush=True)
+            if not bs_grid and nxt <= args.max_batch:
+                bs_grid.append(nxt)
+                nxt *= 2
+        except Exception as e:   # noqa: BLE001 — the OOM edge IS the result
+            rows.append({"batch_size": bs, "seq": args.seq,
+                         "error": f"{type(e).__name__}: {str(e)[:200]}"})
+            print(f"[ddp-util] bs={bs}: {type(e).__name__} (edge found)",
+                  flush=True)
+            break
+
+    out = Path(args.out_dir)
+    out.mkdir(exist_ok=True)
+    path = out / f"utilization_{platform}.json"
+    path.write_text(json.dumps(
+        {"model": args.model, "platform": platform, "rows": rows},
+        indent=1))
+    print(f"[ddp-util] wrote {path}")
+
+    # append/replace our section in EXPERIMENTS.md
+    md = [SECTION, "",
+          f"`scripts/ddp_utilization.py --model {args.model} --seq "
+          f"{args.seq}` on {platform} — twin of the reference's "
+          "bs 8/32/64/128 GPU-utilization table "
+          "(`DDP/EXPERIMENTS.md:9-12`), with TPU-honest columns "
+          "(MFU = achieved model TFLOPS / chip bf16 peak; memory is the "
+          "compile-time plan — this substrate exposes no runtime "
+          "allocator stats).", "",
+          "| batch | step ms | samples/s | TFLOPS/dev | MFU | "
+          "plan GB |", "|---|---|---|---|---|---|"]
+    for r in rows:
+        if "error" in r:
+            md.append(f"| {r['batch_size']} | — | — | — | — | "
+                      f"**edge: {r['error'][:60]}** |")
+        else:
+            md.append(f"| {r['batch_size']} | {r['step_ms']} | "
+                      f"{r['samples_per_sec']} | {r['tflops_per_device']} "
+                      f"| {r['mfu_pct']}% | {r['memory_plan_gb']} |")
+    md.append("")
+    exp = Path("EXPERIMENTS.md")
+    text = exp.read_text() if exp.exists() else ""
+    if SECTION in text:
+        head, _, tail = text.partition(SECTION)
+        rest = tail.split("\n## ", 1)
+        text = head + "\n".join(md) + (
+            "\n## " + rest[1] if len(rest) > 1 else "")
+    else:
+        text = text.rstrip() + "\n\n" + "\n".join(md)
+    exp.write_text(text)
+    print("[ddp-util] EXPERIMENTS.md updated")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,10 +1,14 @@
-"""MoE transformer benchmark: dispatch × batch on the local chip.
+"""MoE transformer benchmark: dispatch × capacity × precision on chip.
 
 Measures the switch-MoE flagship geometry (8 experts × moe_ffn 2752 —
 the dense 3B-L8's MLP FLOPs split 4-ways active) through the FSDP train
-step at seq 8192, comparing the sort-based dispatch against the one-hot
-einsum oracle.  Writes ``moe_results/moe_<platform>.json`` rows in the
-long-context sweep's schema (+ ``config``), consumed by
+step at seq 8192.  The grid headlines the r3 "grouped" dispatch with a
+capacity-factor sweep (2.0 / 1.25 / 1.0) and its int8 row, keeping the
+r2 "sort" and "einsum" paths for the A/B record.  Writes
+``moe_results/moe_<platform>.json`` as ``{"rows": [...],
+"drop_rates_at_init": [...]}`` — the drop rates come from the SAME
+capacity rule the timed path enforces
+(``parallel.expert.grouped_drop_fraction``) — consumed by
 ``scripts/analyze_results.py``.
 
     python scripts/moe_bench.py [--steps 6]
@@ -22,10 +26,44 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import bench  # noqa: E402
 
 BASE = {"n_experts": 8, "moe_ffn": 2752, "num_hidden_layers": 8}
-GRID = [({"moe_dispatch": "sort"}, 2), ({"moe_dispatch": "sort"}, 4),
-        ({"moe_dispatch": "einsum"}, 2), ({"moe_dispatch": "einsum"}, 4),
-        ({"moe_dispatch": "sort", "matmul_precision": "int8_bwd"}, 2),
-        ({"moe_dispatch": "sort", "matmul_precision": "int8_bwd"}, 4)]
+GRID = [
+    # the r3 default: grouped one-hot dispatch, capacity-factor sweep
+    ({"moe_dispatch": "grouped"}, 4),
+    ({"moe_dispatch": "grouped", "moe_capacity_factor": 1.25}, 4),
+    ({"moe_dispatch": "grouped", "moe_capacity_factor": 1.0}, 4),
+    ({"moe_dispatch": "grouped", "moe_capacity_factor": 1.25,
+      "matmul_precision": "int8_bwd"}, 4),
+    ({"moe_dispatch": "grouped"}, 2),
+    # r2 paths, kept for the A/B record
+    ({"moe_dispatch": "sort"}, 4),
+    ({"moe_dispatch": "sort", "matmul_precision": "int8_bwd"}, 4),
+    ({"moe_dispatch": "einsum"}, 2),
+]
+
+
+def measure_drop_rates(seq: int, batch: int, *, hidden: int,
+                       n_experts: int, group_sizes=(128,),
+                       cap_factors=(2.0, 1.25, 1.0), seed=0):
+    """Fraction of tokens dropped by the per-group capacity rule, for
+    router logits at init (random weights, random tokens — the routing
+    distribution the throughput rows above are timed under; trained
+    routers are more balanced once the aux loss bites).  Delegates the
+    capacity rule to ``expert.grouped_drop_fraction`` so this report
+    cannot drift from the timed dispatch's semantics."""
+    import jax
+    import jax.numpy as jnp
+    from distributed_training_sandbox_tpu.parallel.expert import (
+        grouped_drop_fraction)
+    N = batch * seq
+    key = jax.random.PRNGKey(seed)
+    x = jax.random.normal(key, (N, hidden), jnp.bfloat16)
+    wr = jax.random.normal(jax.random.PRNGKey(seed + 1),
+                           (hidden, n_experts)) * hidden ** -0.5
+    assignment = jnp.argmax(x.astype(jnp.float32) @ wr, axis=-1)
+    return [{"group_size": G, "capacity_factor": cf,
+             "drop_fraction": round(float(grouped_drop_fraction(
+                 assignment, n_experts, G, cf)), 4)}
+            for G in group_sizes for cf in cap_factors]
 
 
 def main(argv=None):
@@ -50,10 +88,17 @@ def main(argv=None):
                          "error": f"{type(e).__name__}: {str(e)[:160]}"})
         print(f"[moe-bench] {rows[-1]}", flush=True)
 
+    from distributed_training_sandbox_tpu.models import transformer as T
+    mcfg = getattr(T, args.model)
+    drops = measure_drop_rates(args.seq, 4, hidden=mcfg.hidden_size,
+                               n_experts=BASE["n_experts"])
+    print(f"[moe-bench] drop rates: {drops}", flush=True)
+
     out = Path(args.out_dir)
     out.mkdir(exist_ok=True)
     path = out / f"moe_{jax.devices()[0].platform}.json"
-    path.write_text(json.dumps(rows, indent=1))
+    path.write_text(json.dumps(
+        {"rows": rows, "drop_rates_at_init": drops}, indent=1))
     print(f"[moe-bench] wrote {path}")
 
 

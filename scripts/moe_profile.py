@@ -1,0 +1,162 @@
+"""Phase breakdown of the MoE transformer train step from a profiler trace.
+
+VERDICT r2 asked where the MoE step's time goes: the step is jitted, so
+the split is recovered the same way ``utils/trace_analysis.py`` recovers
+comm-vs-compute — capture a ``jax.profiler`` trace of a few steps and
+aggregate device-op durations by the ``jax.named_scope`` phase each HLO
+op carries in its metadata (``moe_route`` / ``moe_dispatch`` /
+``moe_expert_mlp`` / ``moe_a2a_*`` / ``moe_combine`` / ``moe_aux_loss``
+vs everything else: attention, projections, loss, optimizer).
+
+    python scripts/moe_profile.py [--batch 4] [--steps 4] \
+        [--capacity-factor 2.0] [--dispatch sort]
+
+Writes ``moe_results/moe_phase_breakdown_<platform>.json`` and prints a
+table.  Run once per knob setting to compare.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+import time
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+PHASES = ["moe_route", "moe_dispatch", "moe_expert_mlp", "moe_a2a_out",
+          "moe_a2a_back", "moe_combine", "moe_aux_loss"]
+
+
+def aggregate_trace(trace_dir: str) -> dict[str, float]:
+    """Sum device-op durations (us) keyed by MoE phase.
+
+    Only the device pid's "XLA Ops" lane is counted, and ``while.*``
+    events are dropped — they are the ``lax.scan`` wrappers whose spans
+    contain their children's (so counting both double-counts the scan
+    body).  Leaf ops carry the ``jax.named_scope`` path in ``tf_op``
+    metadata ("jit(step)/moe_dispatch/add"), which is what the phases
+    match against."""
+    files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+    if not files:
+        return {}
+    tf = max(files, key=os.path.getmtime)
+    data = json.load(gzip.open(tf, "rt"))
+    dev_pids, ops_lanes = set(), set()
+    for e in data["traceEvents"]:
+        if e.get("ph") != "M":
+            continue
+        name = e.get("args", {}).get("name", "")
+        if e.get("name") == "process_name" and ("TPU" in name
+                                                or "/device:" in name):
+            dev_pids.add(e["pid"])
+        if e.get("name") == "thread_name" and name == "XLA Ops":
+            ops_lanes.add((e["pid"], e["tid"]))
+    agg: dict[str, float] = defaultdict(float)
+    for e in data["traceEvents"]:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        if ops_lanes and (e["pid"], e.get("tid")) not in ops_lanes:
+            continue
+        name = e.get("name", "")
+        if name.startswith(("while.", "while_", "conditional")):
+            continue
+        tf_op = str((e.get("args", {}) or {}).get("tf_op", ""))
+        for ph in PHASES:
+            if ph in tf_op:
+                agg[ph] += float(e.get("dur", 0.0))
+                break
+        else:
+            agg["other"] += float(e.get("dur", 0.0))
+    return dict(agg)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="SMOLLM3_3B_L8")
+    p.add_argument("--seq", type=int, default=8192)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--steps", type=int, default=4)
+    p.add_argument("--capacity-factor", type=float, default=2.0)
+    p.add_argument("--dispatch", default="sort")
+    p.add_argument("--dense", action="store_true",
+                   help="profile the dense model instead (phase table will "
+                        "be all 'other'; gives the comparison step time)")
+    p.add_argument("--out-dir", default="moe_results")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.parallel import fsdp
+    from distributed_training_sandbox_tpu.utils import make_mesh
+
+    cfg = getattr(T, args.model)
+    over = {} if args.dense else {
+        "n_experts": 8, "moe_ffn": 2752,
+        "moe_capacity_factor": args.capacity_factor,
+        "moe_dispatch": args.dispatch}
+    cfg = dataclasses.replace(cfg, **over)
+    mesh = make_mesh()
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    shards = fsdp.shard_params_fsdp(params, mesh)
+    del params
+    opt = fsdp.init_fsdp_opt_state(shards)
+    step = fsdp.make_fsdp_train_step(shards, cfg, mesh,
+                                     reshard_after_forward=True)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (args.batch, args.seq),
+                             0, cfg.vocab_size, jnp.int32)
+    batch = (ids, ids)
+    for _ in range(2):
+        shards, opt, loss = step(shards, opt, batch)
+        np.asarray(loss)
+
+    trace_dir = tempfile.mkdtemp(prefix="moe_prof_")
+    jax.profiler.start_trace(trace_dir)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        shards, opt, loss = step(shards, opt, batch)
+    np.asarray(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+    jax.profiler.stop_trace()
+
+    agg = aggregate_trace(trace_dir)
+    total = sum(agg.values()) or 1.0
+    per_step = {k: v / args.steps / 1e3 for k, v in agg.items()}  # ms
+    moe_ms = sum(v for k, v in per_step.items() if k != "other")
+    print(f"step time: {dt * 1e3:.1f} ms   "
+          f"tok/s {args.batch * args.seq / dt:,.0f}")
+    for k in PHASES + ["other"]:
+        if k in per_step:
+            print(f"  {k:16s} {per_step[k]:8.2f} ms/step  "
+                  f"{100 * agg[k] / total:5.1f}%")
+    print(f"  {'moe total':16s} {moe_ms:8.2f} ms/step")
+
+    out = Path(args.out_dir)
+    out.mkdir(exist_ok=True)
+    row = {"model": args.model, "seq_len": args.seq, "batch": args.batch,
+           "platform": jax.devices()[0].platform,
+           "capacity_factor": args.capacity_factor,
+           "dispatch": args.dispatch if not args.dense else "dense",
+           "step_ms": round(dt * 1e3, 1),
+           "tokens_per_sec": round(args.batch * args.seq / dt, 1),
+           "phase_ms_per_step": {k: round(v, 2)
+                                 for k, v in per_step.items()}}
+    path = out / f"moe_phase_breakdown_{jax.devices()[0].platform}.json"
+    rows = json.loads(path.read_text()) if path.exists() else []
+    rows.append(row)
+    path.write_text(json.dumps(rows, indent=1))
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -84,6 +84,69 @@ def test_sort_dispatch_matches_einsum_dispatch(moe_params, cap_factor):
         np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5), gs, ge)
 
 
+@pytest.mark.parametrize("cap_factor", [8.0, 1.0, 0.75])
+def test_grouped_dispatch_one_group_equals_einsum(moe_params, cap_factor):
+    """"grouped" with group_size == N is definitionally the einsum
+    dispatch (one group, global capacity): outputs, aux AND gradients
+    must match exactly."""
+    x = jax.random.normal(jax.random.PRNGKey(11), (2, 40, HID))
+    args = (x, moe_params.w_router, moe_params.w_gate, moe_params.w_up,
+            moe_params.w_down)
+    yg, auxg = expert.moe_mlp(*args, axis=None, dispatch="grouped",
+                              group_size=80, capacity_factor=cap_factor)
+    ye, auxe = expert.moe_mlp(*args, axis=None, dispatch="einsum",
+                              capacity_factor=cap_factor)
+    np.testing.assert_allclose(np.asarray(yg), np.asarray(ye),
+                               rtol=1e-6, atol=1e-6)
+    assert float(auxg) == pytest.approx(float(auxe), abs=1e-6)
+
+    def scalar(dispatch, **kw):
+        def f(x, wr, wg, wu, wd):
+            y, aux = expert.moe_mlp(x, wr, wg, wu, wd, axis=None,
+                                    dispatch=dispatch,
+                                    capacity_factor=cap_factor, **kw)
+            return jnp.sum(y * y) + aux
+        return f
+    gg = jax.grad(scalar("grouped", group_size=80),
+                  argnums=(0, 1, 2, 3, 4))(*args)
+    ge = jax.grad(scalar("einsum"), argnums=(0, 1, 2, 3, 4))(*args)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5), gg, ge)
+
+
+def test_grouped_dispatch_matches_per_group_einsum(moe_params):
+    """Multi-group "grouped" == running the einsum dispatch on each
+    group's chunk independently (the per-group capacity rule made
+    explicit), at a tight capacity where groups actually drop."""
+    G, NGROUPS = 16, 5
+    x = jax.random.normal(jax.random.PRNGKey(12), (1, G * NGROUPS, HID))
+    args = (moe_params.w_router, moe_params.w_gate, moe_params.w_up,
+            moe_params.w_down)
+    yg, _ = expert.moe_mlp(x, *args, axis=None, dispatch="grouped",
+                           group_size=G, capacity_factor=1.0)
+    chunks = [expert.moe_mlp(x[:, i * G:(i + 1) * G], *args, axis=None,
+                             dispatch="einsum", capacity_factor=1.0)[0]
+              for i in range(NGROUPS)]
+    ref = jnp.concatenate(chunks, axis=1)
+    np.testing.assert_allclose(np.asarray(yg), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_grouped_dispatch_shrinks_non_dividing_group(moe_params):
+    """A group_size that doesn't divide N auto-shrinks to the largest
+    divisor (16 -> 10 for N=40) instead of refusing to train."""
+    assert expert._resolve_group(40, 16) == 10
+    x = jax.random.normal(jax.random.PRNGKey(13), (1, 40, HID))
+    args = (x, moe_params.w_router, moe_params.w_gate, moe_params.w_up,
+            moe_params.w_down)
+    ya, _ = expert.moe_mlp(*args, axis=None, dispatch="grouped",
+                           group_size=16, capacity_factor=1.0)
+    yb, _ = expert.moe_mlp(*args, axis=None, dispatch="grouped",
+                           group_size=10, capacity_factor=1.0)
+    np.testing.assert_allclose(np.asarray(ya), np.asarray(yb),
+                               rtol=1e-6, atol=1e-6)
+
+
 @pytest.mark.parametrize("precision",
                          ["int8", "int8_bwd", "int8_pallas"])
 def test_moe_quantized_experts(moe_params, precision):

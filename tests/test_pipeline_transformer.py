@@ -82,14 +82,86 @@ def test_pipeline_honors_streamed_vocab_loss():
     assert float(a) == pytest.approx(float(b), abs=1e-4)
 
 
-def test_pipeline_rejects_moe_and_too_many_stages():
+def test_pipeline_rejects_bad_configs():
     params, _, _ = _setup()
     with pytest.raises(ValueError, match="n_stages"):
         build_transformer_pipeline(params, CFG, n_stages=99)
-    moe_cfg = dataclasses.replace(T.TINY_LM, n_experts=4, moe_ffn=32)
+    # MoE stages must hold their experts locally — ep sharding is the
+    # dp×ep step's job, not the host-driven pipeline's.
+    moe_cfg = dataclasses.replace(T.TINY_LM, n_experts=4, moe_ffn=32,
+                                  ep_axis="ep")
     moe_params = T.init_params(jax.random.PRNGKey(2), moe_cfg)
-    with pytest.raises(ValueError, match="aux"):
+    with pytest.raises(ValueError, match="ep_axis"):
         build_transformer_pipeline(moe_params, moe_cfg, n_stages=2)
+
+
+MOE_CFG = dataclasses.replace(
+    T.TINY_LM, tie_word_embeddings=False, n_experts=4, moe_ffn=32,
+    moe_capacity_factor=1.0,  # tight capacity: drops + aux both active
+    # group == one sequence row: the grouped-capacity partition is then
+    # identical whether the batch is seen whole (monolithic) or in
+    # microbatches — the condition for exact PP parity.
+    moe_group_size=32)
+
+
+@pytest.mark.parametrize("runner", [run_gpipe, run_1f1b])
+def test_moe_pipeline_matches_monolithic(runner):
+    """MoE×PP: the per-stage aux-loss threading must reproduce the
+    monolithic MoE step — loss (lm + weighted balance aux) AND updated
+    params, including router/expert leaves on every stage.
+
+    The monolithic reference computes the MICROBATCHED objective
+    (mean of per-microbatch lm_loss, each with ITS chunk's aux): the
+    Switch balance term Σ_e frac_e·mean_p_e is nonlinear in the batch
+    partition, so any gradient-accumulation trainer — this pipeline, or
+    torch grad-accum — optimizes exactly this, not the whole-batch aux."""
+    n_micro = 4
+    params = T.init_params(jax.random.PRNGKey(3), MOE_CFG)
+    ids = jax.random.randint(jax.random.PRNGKey(4), (8, 32), 0,
+                             MOE_CFG.vocab_size)
+    labels = jnp.roll(ids, -1, axis=1)
+    lr = 1e-3
+
+    def loss_fn(p):
+        tot = 0.0
+        mbs = 8 // n_micro
+        for m in range(n_micro):
+            sl = slice(m * mbs, (m + 1) * mbs)
+            tot = tot + T.lm_loss(p, (ids[sl], labels[sl]),
+                                  MOE_CFG) / n_micro
+        return tot
+    want_loss, g = jax.value_and_grad(loss_fn)(params)
+    st = optim.adam_init(params)
+    want_params, _ = optim.adam_update(g, st, params, lr=lr)
+
+    stages = build_transformer_pipeline(params, MOE_CFG, n_stages=2)
+    got_loss = runner(stages, ids, labels, n_micro=4, lr=lr)
+    assert float(got_loss) == pytest.approx(float(want_loss), abs=3e-4)
+
+    lo = 0
+    for s, stage in enumerate(stages):
+        n_s = jax.tree.leaves(stage.params["layers"])[0].shape[0]
+        for k, v in stage.params["layers"].items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(want_params["layers"][k]
+                                          [lo:lo + n_s]),
+                rtol=3e-4, atol=3e-4, err_msg=f"stage{s}:{k}")
+        lo += n_s
+    assert lo == MOE_CFG.num_hidden_layers
+
+
+@pytest.mark.parametrize("runner", [run_gpipe, run_1f1b])
+def test_moe_pipeline_three_stages_multi_device(runner):
+    """3+ stages on DISTINCT devices: the aux terms live on different
+    stage devices and must aggregate on host (regression: jnp.stack of
+    cross-committed scalars crashed exactly here)."""
+    params = T.init_params(jax.random.PRNGKey(5), MOE_CFG)
+    ids = jax.random.randint(jax.random.PRNGKey(6), (6, 32), 0,
+                             MOE_CFG.vocab_size)
+    stages = build_transformer_pipeline(params, MOE_CFG, n_stages=3)
+    assert len({s.device for s in stages}) == 3
+    loss = runner(stages, ids, jnp.roll(ids, -1, axis=1), n_micro=3)
+    assert np.isfinite(loss)
 
 
 def test_transformer_pipeline_1f1b_activation_bound():
