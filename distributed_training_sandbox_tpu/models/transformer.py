@@ -349,10 +349,15 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     r = rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
     aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts:
-        if tp_axis:
-            raise ValueError("MoE layers do not compose with tensor "
-                             "parallelism yet (shard experts via ep_axis)")
+        if tp_axis and cfg.ep_axis:
+            raise ValueError("shard experts over ep OR split them over "
+                             "tp, not both (ep_axis and tp_axis set)")
         from ..parallel.expert import moe_mlp
+        # Under TP each rank holds every expert's F/tp slice (tp_specs):
+        # routing/dispatch are replicated across the tp group (tokens and
+        # router are), the per-expert matmuls produce partial sums, and
+        # one psum after combine rejoins them — the Megatron row/column
+        # pairing applied inside each expert.
         mlp, aux = moe_mlp(r, layer["w_router"], layer["w_gate"],
                            layer["w_up"], layer["w_down"],
                            axis=cfg.ep_axis,
@@ -360,6 +365,11 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
                            dispatch=cfg.moe_dispatch,
                            group_size=cfg.moe_group_size,
                            matmul_precision=cfg.matmul_precision)
+        if tp_axis:
+            from ..ops import collectives as C
+            from ..utils.profiling import scope
+            with scope("tp_moe_psum"):
+                mlp = C.all_reduce(mlp, tp_axis)
     else:
         mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
                     * dense(r, layer["w_up"]), layer["w_down"])

@@ -38,19 +38,25 @@ from . import optim
 
 
 def check_tp_divisibility(cfg: T.TransformerConfig, tp: int) -> None:
-    bad = [(n, v) for n, v in (
-        ("num_attention_heads", cfg.num_attention_heads),
-        ("num_key_value_heads", cfg.num_key_value_heads),
-        ("intermediate_size", cfg.intermediate_size)) if v % tp]
+    dims = [("num_attention_heads", cfg.num_attention_heads),
+            ("num_key_value_heads", cfg.num_key_value_heads)]
+    if cfg.n_experts:
+        dims.append(("moe_ffn", cfg.moe_ffn or cfg.intermediate_size))
+    else:
+        dims.append(("intermediate_size", cfg.intermediate_size))
+    bad = [(n, v) for n, v in dims if v % tp]
     if bad:
         raise ValueError(f"tp={tp} must divide " + ", ".join(
             f"{n}={v}" for n, v in bad))
 
 
 def tp_specs(params, axis: str = "tp") -> dict:
-    """PartitionSpec tree for Megatron sharding.  Stacked layer leaves are
-    (L, in, out): column-parallel ones shard dim 2, row-parallel ones
-    (wo, w_down) shard dim 1; everything else is replicated."""
+    """PartitionSpec tree for Megatron sharding.  Dense stacked layer
+    leaves are (L, in, out): column-parallel ones shard dim 2,
+    row-parallel ones (wo, w_down) shard dim 1.  MoE expert leaves are
+    (L, E, in, out): the SAME column/row roles one dim later — each
+    expert's FFN is Megatron-split across the tp group (w_router, like
+    every other dense leaf, replicated)."""
     row = {"wo", "w_down"}
     col = {"wq", "wk", "wv", "w_gate", "w_up"}
 
@@ -58,9 +64,11 @@ def tp_specs(params, axis: str = "tp") -> dict:
         name = next((getattr(k, "key", None) for k in reversed(path)
                      if getattr(k, "key", None)), None)
         if name in col:
-            return P(None, None, axis)
+            return (P(None, None, None, axis) if leaf.ndim == 4
+                    else P(None, None, axis))
         if name in row:
-            return P(None, axis, None)
+            return (P(None, None, axis, None) if leaf.ndim == 4
+                    else P(None, axis, None))
         return P()
 
     return jax.tree_util.tree_map_with_path(leaf_spec, params)

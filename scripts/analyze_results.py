@@ -102,7 +102,9 @@ def precision_tables(rows: list[dict]) -> str:
 
 
 def load_longctx(dirname: str) -> list[dict]:
-    return _load_json_rows(dirname)
+    # throughput rows only (the dir also holds side artifacts, e.g. the
+    # zigzag FLOP-count comparison)
+    return [r for r in _load_json_rows(dirname) if "model" in r]
 
 
 def longctx_table(rows: list[dict]) -> str:
@@ -176,16 +178,184 @@ def load_pp(dirname: str) -> list[dict]:
 def pp_table(rows: list[dict]) -> str:
     if not rows:
         return "_no pp result JSONs found_\n"
-    out = ["| schedule | final loss | avg loss | avg epoch s | epochs/s | "
-           "total peak MB |",
-           "|---|---|---|---|---|---|"]
+    out = ["| schedule | final loss | avg epoch s | epochs/s | "
+           "mem/stage MB | max stored acts | act MB/microbatch |",
+           "|---|---|---|---|---|---|---|"]
     for r in rows:
-        out.append(f"| {r['schedule']} | {r['final_loss']:.6f} | "
-                   f"{r['avg_loss']:.6f} | {r['avg_epoch_time_s']:.3f} | "
-                   f"{r['epochs_per_s']:.2f} | "
-                   f"{r.get('total_peak_memory_mb', 0):.1f} |")
+        # allocator peaks when available, else the compile-time plan
+        # (memory_source tags which; this substrate exposes no runtime
+        # allocator stats, so the plan is the honest number)
+        mem = (r["peak_memory_mb"]
+               if r.get("memory_source", "allocator") == "allocator"
+               and any(r.get("peak_memory_mb", {}).values())
+               else r.get("memory_plan_mb", {}))
+        fmt = lambda d: "/".join(f"{v:.0f}" for v in d.values()) \
+            if d else "—"
+        out.append(
+            f"| {r['schedule']} | {r['final_loss']:.4f} | "
+            f"{r['avg_epoch_time_s']:.3f} | {r['epochs_per_s']:.2f} | "
+            f"{fmt(mem)}"
+            f"{'' if r.get('memory_source', 'allocator') == 'allocator' else ' (plan)'} | "
+            f"{fmt(r.get('max_stored_activations', {}))} | "
+            f"{'/'.join(str(v) for v in r.get('activation_mb_per_microbatch', {}).values()) or '—'} |")
     out.append("")
     return "\n".join(out)
+
+
+# Chart style: the validated reference palette (dataviz skill) — fixed
+# categorical slot order, light surface, recessive grid, one axis.
+_SURFACE = "#fcfcfb"
+_INK, _INK2 = "#0b0b0b", "#52514e"
+_SERIES = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100", "#e87ba4"]
+
+
+def _style_axes(ax):
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color("#d6d5d1")
+    ax.tick_params(colors=_INK2, labelsize=9)
+    ax.yaxis.grid(True, color="#ececea", linewidth=0.8)
+    ax.set_axisbelow(True)
+    ax.set_facecolor(_SURFACE)
+
+
+def write_plots(prec: list[dict], longctx: list[dict], moe: list[dict],
+                out_dir: str = "plots") -> list[str]:
+    """Committed PNGs — the twin of ``fp8/visualize_code.ipynb`` cells
+    7-10 (matplotlib TFLOPS / tok-s charts)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    Path(out_dir).mkdir(exist_ok=True)
+    written = []
+
+    # --- precision sweep: TFLOPS/dev by seq, series = precision --------
+    models = sorted({r["model"] for r in prec})
+    precs = [q for q in ("bf16", "int8", "int8_bwd", "int8_pallas")
+             if any(r["precision"] == q for r in prec)]
+    if models and precs:
+        fig, axes = plt.subplots(1, len(models),
+                                 figsize=(4.6 * len(models), 3.4),
+                                 facecolor=_SURFACE, squeeze=False)
+        for ax, m in zip(axes[0], models):
+            seqs = sorted({r["sequence_length"] for r in prec
+                           if r["model"] == m})
+            w = 0.8 / len(precs)
+            for i, q in enumerate(precs):
+                vals = []
+                for s in seqs:
+                    rs = [r for r in prec if r["model"] == m
+                          and r["precision"] == q
+                          and r["sequence_length"] == s]
+                    vals.append(rs[0]["tflops_per_device"] if rs
+                                else 0.0)
+                xs = [j + (i - (len(precs) - 1) / 2) * w
+                      for j in range(len(seqs))]
+                ax.bar(xs, vals, width=w * 0.92, color=_SERIES[i],
+                       label=q, zorder=2)
+            ax.set_xticks(range(len(seqs)), [str(s) for s in seqs])
+            ax.set_title(m, color=_INK, fontsize=10)
+            ax.set_xlabel("sequence length", color=_INK2, fontsize=9)
+            _style_axes(ax)
+        axes[0][0].set_ylabel("TFLOPS / device", color=_INK2, fontsize=9)
+        axes[0][-1].legend(frameon=False, fontsize=8, labelcolor=_INK2)
+        fig.suptitle("Precision sweep — achieved TFLOPS per device",
+                     color=_INK, fontsize=11)
+        fig.tight_layout()
+        f = f"{out_dir}/precision_tflops.png"
+        fig.savefig(f, dpi=150, facecolor=_SURFACE)
+        plt.close(fig)
+        written.append(f)
+
+    # --- long-context curve -------------------------------------------
+    lrows = sorted((r for r in longctx if "tflops_per_device" in r),
+                   key=lambda r: r["seq_len"])
+    if lrows:
+        fig, ax = plt.subplots(figsize=(5.4, 3.4), facecolor=_SURFACE)
+        precs_l = []
+        for r in lrows:   # series per precision, fixed slot order
+            q = r.get("config", {}).get("matmul_precision", "bf16")
+            if q not in precs_l:
+                precs_l.append(q)
+        allx = sorted({r["seq_len"] for r in lrows})
+        for i, q in enumerate(precs_l):
+            rs = [r for r in lrows
+                  if r.get("config", {}).get("matmul_precision",
+                                             "bf16") == q]
+            xs = [r["seq_len"] for r in rs]
+            ys = [r["tflops_per_device"] for r in rs]
+            ax.plot(xs, ys, color=_SERIES[i], linewidth=2, marker="o",
+                    markersize=5, zorder=3, label=q)
+            for x, y in zip(xs, ys):
+                ax.annotate(f"{y:.0f}", (x, y),
+                            textcoords="offset points", xytext=(0, 7),
+                            ha="center", fontsize=8, color=_INK2)
+        if len(precs_l) > 1:
+            ax.legend(frameon=False, fontsize=8, labelcolor=_INK2)
+        xs = allx
+        ax.set_xscale("log", base=2)
+        ax.set_xticks(xs, [f"{x // 1024}k" for x in xs])
+        ax.set_xlabel("sequence length (one chip, batch 1)",
+                      color=_INK2, fontsize=9)
+        ax.set_ylabel("TFLOPS / device", color=_INK2, fontsize=9)
+        ax.set_title("Long-context training throughput", color=_INK,
+                     fontsize=11)
+        _style_axes(ax)
+        fig.tight_layout()
+        f = f"{out_dir}/longcontext_tflops.png"
+        fig.savefig(f, dpi=150, facecolor=_SURFACE)
+        plt.close(fig)
+        written.append(f)
+
+    # --- MoE: tok/s by dispatch × capacity ----------------------------
+    mrows = [r for r in moe if "tflops_per_device" in r
+             and r.get("batch") == 4]
+    if mrows:
+        fig, ax = plt.subplots(figsize=(6.4, 3.6), facecolor=_SURFACE)
+        labels, vals, colors = [], [], []
+        order = {"grouped": 0, "sort": 1, "einsum": 2}
+        mrows.sort(key=lambda r: (order.get(
+            r["config"].get("moe_dispatch", "?"), 9),
+            r["config"].get("moe_capacity_factor", 2.0)))
+        for r in mrows:
+            c = r["config"]
+            disp = c.get("moe_dispatch", "?")
+            labels.append(f"{disp}\ncf {c.get('moe_capacity_factor', 2.0)}"
+                          + ("\nint8" if "int8" in
+                             c.get("matmul_precision", "") else ""))
+            vals.append(r["tokens_per_sec"])
+            colors.append(_SERIES[order.get(disp, 0) % len(_SERIES)])
+        ax.bar(range(len(vals)), vals, width=0.62, color=colors, zorder=2)
+        for i, v in enumerate(vals):
+            ax.annotate(f"{v / 1e3:.1f}k", (i, v), ha="center",
+                        xytext=(0, 4), textcoords="offset points",
+                        fontsize=8, color=_INK2)
+        # dense bf16 reference from the committed knob matrix (same model,
+        # seq and batch: the explicit_reshard_b2x row), never hardcoded
+        dense = None
+        try:
+            mtx = json.load(open("bench_matrix_tpu.json"))["matrix"]
+            dense = next(r["tokens_per_sec"] for r in mtx
+                         if r.get("config") == "explicit_reshard_b2x")
+        except (OSError, KeyError, StopIteration):
+            pass
+        if dense:
+            ax.axhline(dense, color=_INK2, linewidth=1.2,
+                       linestyle=(0, (4, 3)))
+            ax.annotate(f"dense bf16: {dense / 1e3:.1f}k", (-0.45, dense),
+                        ha="left", va="bottom", fontsize=8, color=_INK2)
+        ax.set_xticks(range(len(labels)), labels, fontsize=8)
+        ax.set_ylabel("tokens / s", color=_INK2, fontsize=9)
+        ax.set_title("MoE throughput by dispatch — 3B-L8, 8 experts, "
+                     "seq 8192, b4", color=_INK, fontsize=10)
+        _style_axes(ax)
+        fig.tight_layout()
+        f = f"{out_dir}/moe_dispatch_toks.png"
+        fig.savefig(f, dpi=150, facecolor=_SURFACE)
+        plt.close(fig)
+        written.append(f)
+    return written
 
 
 def main(argv=None):
@@ -195,6 +365,8 @@ def main(argv=None):
     p.add_argument("--longctx-dir", default="longcontext_results")
     p.add_argument("--moe-dir", default="moe_results")
     p.add_argument("--out", default="RESULTS.md")
+    p.add_argument("--plots", action="store_true",
+                   help="additionally render PNG charts under plots/")
     args = p.parse_args(argv)
 
     prec = load_precision(args.precision_dir)
@@ -237,6 +409,11 @@ def main(argv=None):
         "",
         moe_table(moe),
     ]
+    if args.plots:
+        pngs = write_plots(prec, longctx, moe)
+        doc += ["## Plots", ""] + [f"![{Path(f).stem}]({f})" for f in pngs]
+        doc.append("")
+        print(f"[analyze] plots: {', '.join(pngs)}")
     Path(args.out).write_text("\n".join(doc))
     print(f"[analyze] {len(prec)} precision rows, {len(pp)} pp rows, "
           f"{len(longctx)} long-context rows, {len(moe)} moe rows "

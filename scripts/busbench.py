@@ -58,15 +58,19 @@ def make_markdown(results, platform: str, n: int) -> str:
     ]
     if platform != "tpu":
         lines += [
-            "> **SIMULATED MESH** — these numbers exercise the collective",
-            "> choreography on host memory, not ICI. Re-run on a multi-chip",
-            "> TPU slice for the real table (same command, no flags).",
+            "> **HARNESS VALIDATION ONLY — simulated mesh.** These numbers",
+            "> exercise the collective choreography on host memory; they",
+            "> carry NO bandwidth information about ICI.  The BASELINE.md",
+            "> ICI deliverable requires a real multi-chip slice (same",
+            "> command, no flags).",
             "",
         ]
     elif n == 1:
         lines += [
-            "> **Single chip** — no ICI links; collectives are intra-chip",
-            "> no-ops/copies. Re-run on a multi-chip slice for ICI numbers.",
+            "> **HARNESS VALIDATION ONLY — single chip.** No ICI links;",
+            "> multi-device collectives read 0 and ppermute is an HBM",
+            "> self-copy.  The BASELINE.md ICI deliverable requires a real",
+            "> multi-chip slice.",
             "",
         ]
     lines += ["Reference interconnects for the NCCL side of the side-by-side",
@@ -133,6 +137,10 @@ def main(argv=None):
     out = Path(args.out_dir)
     out.mkdir(parents=True, exist_ok=True)
     tag = f"busbench_{platform}_{n}dev"
+    if platform != "tpu" or n == 1:
+        # carry the caveat in the FILENAME so nobody mistakes a sim/1-chip
+        # run for the ICI deliverable (VERDICT r2 #10)
+        tag += "_harness_validation"
     (out / f"{tag}.json").write_text(json.dumps(
         [r.to_dict() for r in results], indent=2) + "\n")
     md = make_markdown(results, platform, n)

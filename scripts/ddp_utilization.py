@@ -158,9 +158,10 @@ def main(argv=None):
             md.append(f"| {r['batch_size']} | — | — | — | — | "
                       f"**edge: {r['error'][:60]}** |")
         else:
+            mfu = f"{r['mfu_pct']}%" if r["mfu_pct"] is not None else "—"
             md.append(f"| {r['batch_size']} | {r['step_ms']} | "
                       f"{r['samples_per_sec']} | {r['tflops_per_device']} "
-                      f"| {r['mfu_pct']}% | {r['memory_plan_gb']} |")
+                      f"| {mfu} | {r['memory_plan_gb']} |")
     md.append("")
     exp = Path("EXPERIMENTS.md")
     text = exp.read_text() if exp.exists() else ""

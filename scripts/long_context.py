@@ -39,11 +39,17 @@ def main(argv=None):
     import jax
     rows = []
     for seq in args.seqs:
+      for precision in ("bf16", "int8_bwd"):
         # The streamed-loss chunk buffer is B·S·chunk fp32 — at 64k the
         # default 16032-row chunk alone is ~4.2 GB (doesn't fit next to
         # the activations), so extreme lengths use a narrower chunk
-        # (more scan steps, same math).
+        # (more scan steps, same math).  int8_bwd has the same residency
+        # as bf16 (custom-vjp residuals are (x, w) either way) and moved
+        # the 64k row 83.5 -> 94.4 TFLOPS in r3; remat alternatives
+        # (save_attn even at a halved loss chunk) OOM at 17.08/15.75 GB.
         over = {"loss_vocab_chunk": 4008} if seq > 32768 else {}
+        if precision != "bf16":
+            over = {**over, "matmul_precision": precision}
         try:
             r = bench.measure(args.model, seq, 1, num_steps=args.steps,
                               cfg_overrides=over)
@@ -60,12 +66,15 @@ def main(argv=None):
     path = out / f"longcontext_{platform}.json"
     path.write_text(json.dumps(rows, indent=1))
 
-    print(f"\n| seq | tok/s | step ms | TFLOPS/device |\n|---|---|---|---|")
+    print("\n| seq | precision | tok/s | step ms | TFLOPS/device |"
+          "\n|---|---|---|---|---|")
     for r in rows:
+        prec = r.get("config", {}).get("matmul_precision", "bf16")
         if "error" in r:
-            print(f"| {r['seq_len']} | — | — | {r['error'][:60]} |")
+            print(f"| {r['seq_len']} | {prec} | — | — | "
+                  f"{r['error'][:60]} |")
         else:
-            print(f"| {r['seq_len']} | {r['tokens_per_sec']:.0f} "
+            print(f"| {r['seq_len']} | {prec} | {r['tokens_per_sec']:.0f} "
                   f"| {r['step_ms']:.0f} | {r['tflops_per_device']:.2f} |")
     print(f"\n[longctx] wrote {path}")
 

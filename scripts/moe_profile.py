@@ -86,7 +86,8 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--steps", type=int, default=4)
     p.add_argument("--capacity-factor", type=float, default=2.0)
-    p.add_argument("--dispatch", default="sort")
+    p.add_argument("--dispatch", default="grouped",
+                   help="grouped (production default) | sort | einsum")
     p.add_argument("--dense", action="store_true",
                    help="profile the dense model instead (phase table will "
                         "be all 'other'; gives the comparison step time)")
