@@ -62,8 +62,10 @@ STRATEGY_SCRIPTS = {
     "tp": "train_tp.py",
     "moe": "moe.py",
     "train_moe": "train_moe.py",
+    "ddp_utilization": "ddp_utilization.py",
 }
-# (ops_demo / long_context / memory_waterline / analyze_results are NOT
+# (ops_demo / long_context / memory_waterline / analyze_results /
+# moe_bench / moe_profile / zigzag_flops / make_ops_notebook are NOT
 # registered: they don't speak the strategy CLI contract the launcher
 # injects (--num-steps/--cpu-devices) — run them directly.)
 

@@ -104,6 +104,9 @@ class TransformerConfig:
     # "einsum" (whole-chunk one-hot oracle == grouped with one group).
     moe_dispatch: str = "grouped"
     moe_group_size: int = 128  # tokens per dispatch group ("grouped" only)
+    # experts per token: 1 = Switch, 2+ = GShard top-k (normalized gates,
+    # active FLOPs ×k; requires the grouped dispatch).
+    moe_top_k: int = 1
     ep_axis: str | None = None
 
     def __post_init__(self):
@@ -122,6 +125,10 @@ class TransformerConfig:
                 "(see parallel.sequence.sp_config)")
         if self.ring_layout not in ("contiguous", "zigzag"):
             raise ValueError(f"unknown ring_layout {self.ring_layout!r}")
+        if self.moe_top_k > 1 and self.moe_dispatch != "grouped":
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} requires moe_dispatch="
+                f"'grouped' (got {self.moe_dispatch!r})")
 
     @property
     def resolved_head_dim(self) -> int:
@@ -152,6 +159,24 @@ SMOLLM3_3B = TransformerConfig()
 # one 16 GB v5e with AdamW state; fused attention + streamed vocab loss.
 SMOLLM3_3B_L8 = TransformerConfig(
     num_hidden_layers=8, attention_impl="flash", loss_vocab_chunk=16_032)
+
+# Qwen3-4B-class geometry — the reference fp8 benchmark's default model
+# family (``fp8/modal_app.py:40``: Qwen/Qwen3-4B): hidden 2560, 9728
+# FFN, 32/8 GQA heads at head_dim 128, 151936 vocab, rope 1M.  Geometry
+# class only (random init like every config here); Qwen3's QK-norm is
+# not modeled — the benchmark-relevant shapes are.
+QWEN3_4B = TransformerConfig(
+    vocab_size=151_936, hidden_size=2560, intermediate_size=9728,
+    num_hidden_layers=36, num_attention_heads=32, num_key_value_heads=8,
+    head_dim=128, rope_theta=1_000_000.0, nope_interval=0)
+
+# One-chip flagship sibling (same per-layer geometry, 6 layers — the
+# L8 trick applied to the 4B family).
+QWEN3_4B_L6 = TransformerConfig(
+    vocab_size=151_936, hidden_size=2560, intermediate_size=9728,
+    num_hidden_layers=6, num_attention_heads=32, num_key_value_heads=8,
+    head_dim=128, rope_theta=1_000_000.0, nope_interval=0,
+    attention_impl="flash", loss_vocab_chunk=15_194)
 
 # Smaller siblings for 1-chip benches and CI (same shape family).
 SMOLLM3_350M = TransformerConfig(
@@ -364,6 +389,7 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
                            capacity_factor=cfg.moe_capacity_factor,
                            dispatch=cfg.moe_dispatch,
                            group_size=cfg.moe_group_size,
+                           top_k=cfg.moe_top_k,
                            matmul_precision=cfg.matmul_precision)
         if tp_axis:
             from ..ops import collectives as C

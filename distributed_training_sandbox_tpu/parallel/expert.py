@@ -106,13 +106,19 @@ def _group_slot_positions(eg: jax.Array, n_experts: int):
 
 def grouped_drop_fraction(expert: jax.Array, n_experts: int,
                           group_size: int, capacity_factor: float):
-    """Fraction of tokens the grouped dispatch would drop for the given
-    per-token expert assignment — computed with the SAME helpers as
-    ``moe_mlp``'s "grouped" branch, so reports (scripts/moe_bench.py)
-    cannot drift from the timed path's semantics."""
-    N = expert.shape[0]
-    G, NG, capg = _grouped_caps(N, group_size, capacity_factor, n_experts)
-    _, pos = _group_slot_positions(expert.reshape(NG, G), n_experts)
+    """Fraction of (token, assignment) pairs the grouped dispatch would
+    drop — computed with the SAME helpers as ``moe_mlp``'s "grouped"
+    branch, so reports (scripts/moe_bench.py) cannot drift from the
+    timed path's semantics.  ``expert``: (N,) top-1 assignments or
+    (N, k) top-k (choice-major priority, capacity cf·k·G/E — exactly the
+    dispatch's rule)."""
+    if expert.ndim == 1:
+        expert = expert[:, None]
+    N, k = expert.shape
+    G, NG, capg = _grouped_caps(N, group_size, capacity_factor * k,
+                                n_experts)
+    eg = expert.reshape(NG, G, k).transpose(0, 2, 1).reshape(NG, k * G)
+    _, pos = _group_slot_positions(eg, n_experts)
     return jnp.mean((jnp.max(pos, axis=-1) >= capg).astype(jnp.float32))
 
 
@@ -125,9 +131,22 @@ def _route_top1(x2d, w_router):
     return gate, expert, probs
 
 
+def _route_topk(x2d, w_router, k: int):
+    """(N, H) tokens → (gates (N, k), experts (N, k), probs (N, E)).
+    k = 1 keeps the Switch convention (gate = raw top prob); k ≥ 2
+    normalizes the gates over the chosen experts (GShard top-2)."""
+    logits = (x2d @ w_router).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, experts = lax.top_k(probs, k)
+    if k > 1:
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    return gates, experts, probs
+
+
 def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
             capacity_factor: float = 2.0, dispatch: str = "grouped",
-            group_size: int = 128, matmul_precision: str = "bf16"):
+            group_size: int = 128, top_k: int = 1,
+            matmul_precision: str = "bf16"):
     """The switch-MoE MLP on local tokens ``x`` (B, S, H) →
     ``(y, aux_loss)``.  ``w_gate/w_up/w_down`` hold this device's
     ``E_local`` experts on dim 0; ``axis=None`` means no expert
@@ -158,6 +177,12 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
         over the WHOLE chunk (GShard with one group).  O(N·E·C·H)
         compute — the semantics oracle: "grouped" with group_size=N
         computes identical outputs/gradients (pinned by tests).
+
+    ``top_k``: experts per token.  1 = Switch (gate = raw top prob);
+    2+ = GShard-style top-k (gates normalized over the chosen experts,
+    per-group capacity capg = ceil(cf·k·G/E) counted with FIRST choices
+    ahead of second choices — bursty seconds drop first).  top_k > 1
+    requires the "grouped" dispatch.
     """
     ep = lax.axis_size(axis) if axis else 1
     B, S, H = x.shape
@@ -169,23 +194,34 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
                          f"hold {E_local} each")
     cap = int(-(-N * capacity_factor // E))
     x2d = x.reshape(N, H)
+    if top_k > 1 and dispatch != "grouped":
+        raise ValueError(f"top_k={top_k} requires dispatch='grouped' "
+                         f"(got {dispatch!r})")
 
     with scope("moe_route"):
-        gate, expert, probs = _route_top1(x2d, w_router)
+        gates, experts, probs = _route_topk(x2d, w_router, top_k)
+        gate, expert = gates[:, 0], experts[:, 0]  # k=1 paths' view
 
     if dispatch == "grouped":
-        G, NG, capg = _grouped_caps(N, group_size, capacity_factor, E)
+        G, NG, capg = _grouped_caps(N, group_size,
+                                    capacity_factor * top_k, E)
         cap = NG * capg   # downstream a2a reshapes see one (E, cap, H)
         with scope("moe_dispatch"):
-            onehot, pos = _group_slot_positions(expert.reshape(NG, G), E)
+            # assignments flattened FIRST-choices-first within each
+            # group: index j·G + t — earlier choices claim capacity
+            # before any second choice does.
+            eg = experts.reshape(NG, G, top_k).transpose(
+                0, 2, 1).reshape(NG, top_k * G)
+            onehot, pos = _group_slot_positions(eg, E)
             kept = (pos < capg) & (onehot > 0)
             slotoh = jax.nn.one_hot(jnp.clip(pos, 0, capg - 1), capg,
                                     dtype=jnp.bool_)
             disp = (kept[..., None] & slotoh).reshape(
-                NG, G, E * capg).astype(x.dtype)                # (NG, G, S)
-            # per-group dispatch matmul; the transpose is layout-regular
-            # (leading dims only), which XLA moves at HBM rate.
-            buckets = jnp.einsum("gts,gth->gsh", disp,
+                NG, top_k, G, E * capg).astype(x.dtype)      # (NG,k,G,S)
+            # per-group dispatch matmul, contracting token AND choice
+            # dims at once (no tiled token copy); the transpose is
+            # layout-regular (leading dims only) — HBM-rate.
+            buckets = jnp.einsum("gkts,gth->gsh", disp,
                                  x2d.reshape(NG, G, H))
             buckets = buckets.reshape(NG, E, capg, H).transpose(
                 1, 0, 2, 3).reshape(E, cap, H)
@@ -255,11 +291,14 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
     with scope("moe_combine"):
         if dispatch == "grouped":
             # undo the leading-dim transpose, then one combine matmul per
-            # group — the exact adjoint of the dispatch einsum.
+            # group — the exact adjoint of the dispatch einsum; the k
+            # assignment outputs sum gate-weighted per token.
             back_g = ret.reshape(E, NG, capg, H).transpose(
                 1, 0, 2, 3).reshape(NG, E * capg, H)
-            y2d = jnp.einsum("gts,gsh->gth", disp,
-                             back_g).reshape(N, H) * gate[:, None]
+            ya = jnp.einsum("gkts,gsh->gkth", disp, back_g)
+            gates_g = gates.reshape(NG, G, top_k).transpose(0, 2, 1)
+            y2d = jnp.sum(ya * gates_g[..., None].astype(ya.dtype),
+                          axis=1).reshape(N, H)
         elif dispatch == "einsum":
             y2d = jnp.einsum("nec,ech->nh", disp,
                              ret.reshape(E, cap, H)) * gate[:, None]
@@ -272,9 +311,11 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
             y2d = y_sorted[inv] * gate[:, None]
 
     with scope("moe_aux_loss"):
-        # Switch load-balance: fraction of tokens per expert × mean router
-        # prob per expert, summed, scaled by E; averaged over the group.
-        frac = (jnp.bincount(expert, length=E) / N).astype(jnp.float32)
+        # Switch load-balance: fraction of (token, assignment) pairs per
+        # expert × mean router prob per expert, summed, scaled by E;
+        # averaged over the group.  top_k=1 reduces to the Switch eq. 4.
+        frac = (jnp.bincount(experts.reshape(-1), length=E)
+                / (N * top_k)).astype(jnp.float32)
         mean_p = jnp.mean(probs, axis=0)
         if axis:
             frac = C.all_reduce(frac, axis, mean=True)
@@ -285,13 +326,13 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
 
 def moe_layer(params: MoEParams, x, axis: str = "ep", *,
               capacity_factor: float = 2.0, dispatch: str = "grouped",
-              group_size: int = 128):
+              group_size: int = 128, top_k: int = 1):
     """Apply the expert-parallel MoE MLP to local tokens ``x`` (B, S, H)
     (shard_map only).  Returns (y, aux_loss)."""
     return moe_mlp(x, params.w_router, params.w_gate, params.w_up,
                    params.w_down, axis=axis,
                    capacity_factor=capacity_factor, dispatch=dispatch,
-                   group_size=group_size)
+                   group_size=group_size, top_k=top_k)
 
 
 def moe_reference(params: MoEParams, x, *, capacity_factor: float = 2.0):

@@ -40,9 +40,9 @@ from . import optim
 def check_tp_divisibility(cfg: T.TransformerConfig, tp: int) -> None:
     dims = [("num_attention_heads", cfg.num_attention_heads),
             ("num_key_value_heads", cfg.num_key_value_heads)]
-    if cfg.n_experts:
-        dims.append(("moe_ffn", cfg.moe_ffn or cfg.intermediate_size))
-    else:
+    if cfg.n_experts and cfg.moe_ffn:
+        dims.append(("moe_ffn", cfg.moe_ffn))
+    else:   # dense MLP, or experts defaulting to intermediate_size
         dims.append(("intermediate_size", cfg.intermediate_size))
     bad = [(n, v) for n, v in dims if v % tp]
     if bad:

@@ -58,13 +58,16 @@ def get_model_flops_per_token(cfg, seq_len: int, *, backward_factor: float = 2.0
     # attention touches half the positions on average.
     attn_quadratic = 2 * 2 * (n_q * head_dim) * seq_len * (0.5 if causal else 1.0)
     router = 0
+    active_k = 1
     n_exp = getattr(cfg, "n_experts", 0)
     if n_exp:
-        # top-1 switch MoE: each token runs ONE expert of moe_ffn width
-        # (active FLOPs, the MFU-relevant count) plus the router matmul.
+        # top-k MoE: each token runs k experts of moe_ffn width (active
+        # FLOPs, the MFU-relevant count) plus the router matmul.
         inter = getattr(cfg, "moe_ffn", None) or inter
         router = 2 * h * n_exp
-    mlp = (3 if getattr(cfg, "gated_mlp", True) else 2) * 2 * h * inter
+        active_k = getattr(cfg, "moe_top_k", 1)
+    mlp = (3 if getattr(cfg, "gated_mlp", True) else 2) * 2 * h * inter \
+        * active_k
     per_layer = q_proj + kv_proj + o_proj + attn_quadratic + mlp + router
     head = 2 * h * vocab
     fwd = layers * per_layer + head

@@ -138,7 +138,7 @@ def moe_drop_note(dirname: str) -> str:
             drops += d.get("drop_rates_at_init", [])
     if not drops:
         return ""
-    parts = [f"cf{d['capacity_factor']} "
+    parts = [f"k{d.get('top_k', 1)}/cf{d['capacity_factor']} "
              f"{100 * d['drop_fraction']:.1f}%" for d in drops]
     return ("  Grouped drop rates at init (group "
             f"{drops[0]['group_size']}): " + ", ".join(parts) + ".")
@@ -147,24 +147,25 @@ def moe_drop_note(dirname: str) -> str:
 def moe_table(rows: list[dict]) -> str:
     if not rows:
         return "_no MoE benchmark found_\n"
-    out = ["| model | platform | seq | batch | dispatch | cf | precision "
-           "| tok/s | TFLOPS/device (active) |",
-           "|---|---|---|---|---|---|---|---|---|"]
+    out = ["| model | platform | seq | batch | dispatch | cf | k | "
+           "precision | tok/s | TFLOPS/device (active) |",
+           "|---|---|---|---|---|---|---|---|---|---|"]
     for r in rows:
         if "tflops_per_device" not in r and "error" not in r:
             continue   # e.g. phase-breakdown / drop-rate side artifacts
         c = r.get("config", {})
         disp = c.get("moe_dispatch", "?")
         cf = c.get("moe_capacity_factor", 2.0)
+        k = c.get("moe_top_k", 1)
         prec = c.get("matmul_precision", "bf16")
         plat = r.get("platform", "?")
         if "error" in r:
             out.append(f"| {r['model']} | {plat} | {r['seq_len']} | "
-                       f"{r['batch']} | {disp} | {cf} | {prec} | — | "
-                       f"{r['error'][:50]} |")
+                       f"{r['batch']} | {disp} | {cf} | {k} | {prec} | "
+                       f"— | {r['error'][:50]} |")
         else:
             out.append(f"| {r['model']} | {plat} | {r['seq_len']} | "
-                       f"{r['batch']} | {disp} | {cf} | {prec} | "
+                       f"{r['batch']} | {disp} | {cf} | {k} | {prec} | "
                        f"{r['tokens_per_sec']:.0f} | "
                        f"{r['tflops_per_device']:.2f} |")
     out.append("")
@@ -317,11 +318,14 @@ def write_plots(prec: list[dict], longctx: list[dict], moe: list[dict],
         order = {"grouped": 0, "sort": 1, "einsum": 2}
         mrows.sort(key=lambda r: (order.get(
             r["config"].get("moe_dispatch", "?"), 9),
+            r["config"].get("moe_top_k", 1),
             r["config"].get("moe_capacity_factor", 2.0)))
         for r in mrows:
             c = r["config"]
             disp = c.get("moe_dispatch", "?")
+            k = c.get("moe_top_k", 1)
             labels.append(f"{disp}\ncf {c.get('moe_capacity_factor', 2.0)}"
+                          + (f"\ntop-{k}" if k > 1 else "")
                           + ("\nint8" if "int8" in
                              c.get("matmul_precision", "") else ""))
             vals.append(r["tokens_per_sec"])

@@ -1,0 +1,136 @@
+"""Generate the NOTEBOOK form of the 02-operations teaching twin.
+
+The reference teaches its communication layer as an interactive notebook
+(``02-operations.ipynb``); this repo's tested script twin is
+``scripts/ops_demo.py``.  VERDICT r2 noted the remaining delta is the
+*form* — so this generator derives a real ``.ipynb`` from the script:
+it splits ``ops_demo.main()`` at its ``# §N`` section markers into code
+cells (one per section, sharing one namespace like notebook cells do),
+EXECUTES them in order capturing each cell's stdout, and writes
+``notebooks/02_operations_tpu.ipynb`` with those real outputs embedded.
+The script stays the source of truth (and the tested artifact); re-run
+this after editing it.
+
+    python scripts/make_ops_notebook.py [--out notebooks/02_operations_tpu.ipynb]
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import re
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+MARKER = re.compile(r"^    # (§\d+[^\n]*)$", re.M)
+
+
+def split_sections() -> tuple[str, list[tuple[str, str]]]:
+    """(module docstring, [(section title, dedented code), ...])."""
+    src = (REPO / "scripts" / "ops_demo.py").read_text()
+    module_doc = src.split('"""')[1]
+    body = src.split("def main() -> dict:", 1)[1]
+    body = body.split('so the test suite can assert semantics, '
+                      "not just 'it printed'.\"\"\"", 1)[1]
+    body = body.split("\nif __name__", 1)[0]
+    # drop the trailing `return results`
+    body = re.sub(r"\n    return results\s*$", "\n", body)
+
+    def dedent4(code: str) -> str:
+        # textwrap.dedent would bail: the banner strings embed column-0
+        # text.  Function-body code is uniformly 4-deep — strip exactly
+        # that from code lines and leave string-internal flush-left
+        # lines untouched.
+        return "\n".join(l[4:] if l.startswith("    ") else l
+                         for l in code.splitlines())
+
+    parts = MARKER.split(body)
+    # parts = [pre, title1, code1, title2, code2, ...]; pre is empty-ish
+    sections = []
+    pre = parts[0]
+    for title, code in zip(parts[1::2], parts[2::2]):
+        sections.append((title.strip(), dedent4(code).strip("\n")))
+    if pre.strip():
+        sections.insert(0, ("setup", dedent4(pre).strip("\n")))
+    return module_doc, sections
+
+
+def helper_cell() -> str:
+    """The script's helper defs, verbatim (banner/tinfo/viz)."""
+    src = (REPO / "scripts" / "ops_demo.py").read_text()
+    helpers = src.split('SEP = "─" * 72', 1)[1]
+    helpers = helpers.split("def main() -> dict:", 1)[0]
+    return ('import io, sys\nfrom pathlib import Path\n'
+            f'sys.path.insert(0, {str(REPO)!r})\n\n'
+            'SEP = "─" * 72' + helpers.rstrip())
+
+
+def run_cells(cells: list[str]) -> list[str]:
+    ns: dict = {}
+    outs = []
+    for code in cells:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            exec(compile(code, "<cell>", "exec"), ns)  # noqa: S102
+        outs.append(buf.getvalue())
+    return outs
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="notebooks/02_operations_tpu.ipynb")
+    args = p.parse_args(argv)
+
+    module_doc, sections = split_sections()
+    code_cells = [helper_cell()] + [c for _, c in sections]
+    outputs = run_cells(code_cells)
+
+    nb_cells = [{
+        "cell_type": "markdown", "metadata": {},
+        "source": ("# 02-operations — the TPU twin, notebook form\n\n"
+                   + module_doc.strip()).splitlines(keepends=True),
+    }]
+    titles = ["helpers (tinfo / viz / banner — nb cell 8)"] + \
+        [t for t, _ in sections]
+    for title, code, out in zip(titles, code_cells, outputs):
+        nb_cells.append({
+            "cell_type": "markdown", "metadata": {},
+            "source": [f"## {title}"],
+        })
+        cell = {
+            "cell_type": "code", "metadata": {},
+            "execution_count": None,
+            "source": code.splitlines(keepends=True),
+            "outputs": [],
+        }
+        if out:
+            cell["outputs"] = [{
+                "output_type": "stream", "name": "stdout",
+                "text": out.splitlines(keepends=True),
+            }]
+        nb_cells.append(cell)
+
+    nb = {
+        "cells": nb_cells,
+        "metadata": {
+            "kernelspec": {"display_name": "Python 3",
+                           "language": "python", "name": "python3"},
+            "language_info": {"name": "python"},
+        },
+        "nbformat": 4, "nbformat_minor": 5,
+    }
+    out_path = REPO / args.out
+    out_path.parent.mkdir(exist_ok=True)
+    out_path.write_text(json.dumps(nb, indent=1))
+    print(f"[ops-notebook] {len(nb_cells)} cells "
+          f"({len(code_cells)} code, executed) -> {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,8 @@ GRID = [
     ({"moe_dispatch": "grouped", "moe_capacity_factor": 1.25,
       "matmul_precision": "int8_bwd"}, 4),
     ({"moe_dispatch": "grouped"}, 2),
+    ({"moe_dispatch": "grouped", "moe_top_k": 2,
+      "moe_capacity_factor": 1.0}, 4),
     # r2 paths, kept for the A/B record
     ({"moe_dispatch": "sort"}, 4),
     ({"moe_dispatch": "sort", "matmul_precision": "int8_bwd"}, 4),
@@ -43,13 +45,15 @@ GRID = [
 
 def measure_drop_rates(seq: int, batch: int, *, hidden: int,
                        n_experts: int, group_sizes=(128,),
-                       cap_factors=(2.0, 1.25, 1.0), seed=0):
-    """Fraction of tokens dropped by the per-group capacity rule, for
-    router logits at init (random weights, random tokens — the routing
-    distribution the throughput rows above are timed under; trained
-    routers are more balanced once the aux loss bites).  Delegates the
-    capacity rule to ``expert.grouped_drop_fraction`` so this report
-    cannot drift from the timed dispatch's semantics."""
+                       cap_factors=(2.0, 1.25, 1.0), top_ks=(1, 2),
+                       seed=0):
+    """Fraction of (token, assignment) pairs dropped by the per-group
+    capacity rule, for router logits at init (random weights, random
+    tokens — the routing distribution the throughput rows above are
+    timed under; trained routers are more balanced once the aux loss
+    bites).  Delegates the capacity rule (incl. top-k choice priority)
+    to ``expert.grouped_drop_fraction`` so this report cannot drift from
+    the timed dispatch's semantics."""
     import jax
     import jax.numpy as jnp
     from distributed_training_sandbox_tpu.parallel.expert import (
@@ -59,11 +63,15 @@ def measure_drop_rates(seq: int, batch: int, *, hidden: int,
     x = jax.random.normal(key, (N, hidden), jnp.bfloat16)
     wr = jax.random.normal(jax.random.PRNGKey(seed + 1),
                            (hidden, n_experts)) * hidden ** -0.5
-    assignment = jnp.argmax(x.astype(jnp.float32) @ wr, axis=-1)
-    return [{"group_size": G, "capacity_factor": cf,
-             "drop_fraction": round(float(grouped_drop_fraction(
-                 assignment, n_experts, G, cf)), 4)}
-            for G in group_sizes for cf in cap_factors]
+    logits = x.astype(jnp.float32) @ wr
+    rows = []
+    for k in top_ks:
+        _, assignment = jax.lax.top_k(logits, k)
+        rows += [{"group_size": G, "capacity_factor": cf, "top_k": k,
+                  "drop_fraction": round(float(grouped_drop_fraction(
+                      assignment, n_experts, G, cf)), 4)}
+                 for G in group_sizes for cf in cap_factors]
+    return rows
 
 
 def main(argv=None):

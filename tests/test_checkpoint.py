@@ -56,6 +56,64 @@ def test_save_restore_resumes_exact_trajectory(mesh8, tmp_path):
         s, s3)
 
 
+def test_moe_ep_checkpoint_resumes_exact_trajectory(mesh8, tmp_path):
+    """The MoE LM's ep-sharded expert leaves round-trip through Orbax
+    with shardings intact and the resumed trajectory matches the
+    unbroken one exactly."""
+    from distributed_training_sandbox_tpu.parallel import expert
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "ep"))
+    cfg = dataclasses.replace(T.TINY_LM, num_hidden_layers=2,
+                              n_experts=4, moe_ffn=32, ep_axis="ep")
+    params = T.init_params(jax.random.PRNGKey(3), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(4), (8, 32), 0,
+                             cfg.vocab_size)
+    batch = (ids, jnp.roll(ids, -1, axis=1))
+
+    shards = expert.shard_moe_lm_params(params, mesh)
+    opt = init_fsdp_opt_state(shards)
+    step = expert.make_moe_lm_train_step(shards, cfg, mesh, donate=False)
+
+    s, o = shards, opt
+    for _ in range(4):
+        s, o, loss_unbroken = step(s, o, batch)
+
+    s2, o2 = shards, opt
+    for _ in range(2):
+        s2, o2, _ = step(s2, o2, batch)
+    mgr = ckpt.checkpoint_manager(tmp_path / "moe_ckpt")
+    ckpt.save_state(mgr, 2, {"params": s2, "opt": o2})
+    restored = ckpt.restore_state(mgr,
+                                  like={"params": shards, "opt": opt})
+    s3, o3 = restored["params"], restored["opt"]
+    assert (s3["layers"]["w_gate"].sharding
+            == shards["layers"]["w_gate"].sharding)
+    assert "ep" in str(s3["layers"]["w_gate"].sharding.spec)
+    for _ in range(2):
+        s3, o3, loss_resumed = step(s3, o3, batch)
+    assert float(loss_resumed) == float(loss_unbroken)
+
+
+def test_tp_checkpoint_roundtrip_preserves_shardings(mesh2x4, tmp_path):
+    """Megatron-sharded (column/row) trees round-trip with shardings —
+    incl. the 4-D MoE expert leaves' F-dim shards."""
+    from distributed_training_sandbox_tpu.parallel import tensor
+
+    cfg = dataclasses.replace(T.TINY_LM, num_hidden_layers=2,
+                              n_experts=4, moe_ffn=32)
+    params = T.init_params(jax.random.PRNGKey(5), cfg)
+    shards = tensor.shard_params_tp(params, mesh2x4, "tp")
+    mgr = ckpt.checkpoint_manager(tmp_path / "tp_ckpt")
+    ckpt.save_state(mgr, 0, {"params": shards})
+    restored = ckpt.restore_state(mgr, like={"params": shards})["params"]
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), restored, shards)
+    assert (restored["layers"]["w_gate"].sharding
+            == shards["layers"]["w_gate"].sharding)
+    assert "tp" in str(restored["layers"]["w_down"].sharding.spec)
+
+
 def test_max_to_keep_prunes_old_steps(mesh8, tmp_path):
     x = jax.device_put(jnp.arange(8.0),
                        jax.sharding.NamedSharding(

@@ -147,6 +147,96 @@ def test_grouped_dispatch_shrinks_non_dividing_group(moe_params):
                                rtol=1e-6, atol=1e-6)
 
 
+def test_ep_grouped_multigroup_matches_local(mesh8, moe_params):
+    """Expert-parallel grouped dispatch with MULTIPLE groups per device
+    (NG > 1) at tight capacity == the all-experts-local grouped result
+    for each device chunk — the a2a moves computation, not semantics."""
+    G = 16
+    x = _tokens(jax.random.PRNGKey(15), 8, 2 * G)  # 2 groups per device
+    sharded = jax.jit(smap(
+        lambda p, x: expert.moe_layer(p, x, "dp", capacity_factor=1.0,
+                                      dispatch="grouped",
+                                      group_size=G)[0],
+        mesh8, in_specs=(expert.moe_specs("dp"), P("dp")),
+        out_specs=P("dp")))
+    got = sharded(expert.shard_moe_params(moe_params, mesh8, "dp"), x)
+
+    args = (moe_params.w_router, moe_params.w_gate, moe_params.w_up,
+            moe_params.w_down)
+    chunks = [expert.moe_mlp(x[i:i + 1], *args, axis=None,
+                             dispatch="grouped", group_size=G,
+                             capacity_factor=1.0)[0] for i in range(8)]
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(jnp.concatenate(chunks, 0)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_top2_matches_dense_reference_no_drops(moe_params):
+    """top-2 at no-drop capacity == the direct dense computation:
+    y = Σ_j gate_j · expert_mlp(x; w[expert_j]) with gates normalized
+    over the two chosen experts."""
+    x = jax.random.normal(jax.random.PRNGKey(20), (1, 64, HID))
+    p = moe_params
+    args = (x, p.w_router, p.w_gate, p.w_up, p.w_down)
+    y, aux = expert.moe_mlp(*args, axis=None, dispatch="grouped",
+                            top_k=2, capacity_factor=8.0)
+
+    x2d = x.reshape(-1, HID)
+    gates, experts, probs = expert._route_topk(x2d, p.w_router, 2)
+    ref = jnp.zeros_like(x2d)
+    for j in range(2):
+        e = experts[:, j]
+        h_g = jnp.einsum("nh,nhf->nf", x2d, p.w_gate[e])
+        h_u = jnp.einsum("nh,nhf->nf", x2d, p.w_up[e])
+        out = jnp.einsum("nf,nfh->nh", jax.nn.silu(h_g) * h_u,
+                         p.w_down[e])
+        ref = ref + out * gates[:, j:j + 1]
+    np.testing.assert_allclose(np.asarray(y.reshape(-1, HID)),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
+    # normalized gates: the two coefficients sum to one per token
+    np.testing.assert_allclose(np.asarray(jnp.sum(gates, -1)), 1.0,
+                               rtol=1e-6)
+    assert float(aux) > 0
+
+
+def test_top2_group_consistency_and_drops(moe_params):
+    """Multi-group top-2 at tight capacity == per-group chunks run
+    independently (the per-group rule), and tightening capacity actually
+    drops second choices (output moves toward the top-1 answer)."""
+    G, NGROUPS = 16, 4
+    x = jax.random.normal(jax.random.PRNGKey(21), (1, G * NGROUPS, HID))
+    p = moe_params
+    args = (p.w_router, p.w_gate, p.w_up, p.w_down)
+    y, _ = expert.moe_mlp(x, *args, axis=None, dispatch="grouped",
+                          group_size=G, top_k=2, capacity_factor=0.75)
+    chunks = [expert.moe_mlp(x[:, i * G:(i + 1) * G], *args, axis=None,
+                             dispatch="grouped", group_size=G, top_k=2,
+                             capacity_factor=0.75)[0]
+              for i in range(NGROUPS)]
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(jnp.concatenate(chunks, 1)),
+                               rtol=1e-6, atol=1e-6)
+    # loose vs tight capacity must differ (drops are real)
+    y_loose, _ = expert.moe_mlp(x, *args, axis=None, dispatch="grouped",
+                                group_size=G, top_k=2,
+                                capacity_factor=8.0)
+    assert float(jnp.max(jnp.abs(y - y_loose))) > 1e-4
+
+    # gradients flow (drops mask, not break, the backward)
+    g = jax.grad(lambda x: jnp.sum(expert.moe_mlp(
+        x, *args, axis=None, dispatch="grouped", group_size=G, top_k=2,
+        capacity_factor=0.75)[0] ** 2))(x)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_topk_requires_grouped(moe_params):
+    x = jax.random.normal(jax.random.PRNGKey(22), (1, 16, HID))
+    with pytest.raises(ValueError, match="grouped"):
+        expert.moe_mlp(x, moe_params.w_router, moe_params.w_gate,
+                       moe_params.w_up, moe_params.w_down, axis=None,
+                       dispatch="sort", top_k=2)
+
+
 @pytest.mark.parametrize("precision",
                          ["int8", "int8_bwd", "int8_pallas"])
 def test_moe_quantized_experts(moe_params, precision):

@@ -109,6 +109,26 @@ def test_moe_lm_train_step_learns(mesh_dp_ep):
     assert counts["all_to_all"] >= 4, counts
 
 
+def test_top2_moe_lm_ep_step_trains(mesh_dp_ep):
+    """The top-2 LM end-to-end over dp×ep: the expert choreography is
+    unchanged (bigger buckets, two gate-weighted combines); the step must
+    train and keep experts sharded."""
+    cfg = dataclasses.replace(TINY_MOE, moe_top_k=2)
+    params = T.init_params(jax.random.PRNGKey(13), cfg)
+    shards = expert.shard_moe_lm_params(params, mesh_dp_ep)
+    opt = init_fsdp_opt_state(shards)
+    step = expert.make_moe_lm_train_step(shards, cfg, mesh_dp_ep,
+                                         donate=False)
+    batch = _batch(cfg, seed=14)
+    losses = []
+    s, o = shards, opt
+    for _ in range(12):
+        s, o, loss = step(s, o, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses[::4]
+    assert "ep" in str(s["layers"]["w_gate"].sharding.spec)
+
+
 def test_3d_dp_sp_ep_moe_step(mesh8):
     """dp×sp×ep: sequence-sharded ring attention + expert-parallel MoE.
     Routing is per-token (argmax), so at no-drop capacity the sharded
