@@ -1,0 +1,122 @@
+"""ctypes bindings for the native (C++) data engine.
+
+The reference's host data path rides torch's C++-backed DataLoader;
+this module is the TPU build's native equivalent for the pieces that
+are hot on the host (``native/dtsdata.cpp``): the alias-method Zipfian
+sampler behind the synthetic stream, the window packer, and epoch
+shuffles.  The shared library builds on first use with plain ``g++``
+(no pybind11) and caches next to the source; every entry point has the
+numpy twin in ``packing.py``, so environments without a toolchain lose
+speed, not function — check ``available()``.
+
+Determinism: native streams are pure functions of (args, seed) —
+identical across runs/hosts — but the Zipf sampler is its OWN stream,
+not bit-identical to numpy's ``Generator.choice`` (the packer IS exact:
+pure arithmetic, equality-pinned by tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_SRC = Path(__file__).resolve().parents[2] / "native" / "dtsdata.cpp"
+_LIB = _SRC.with_name("libdtsdata.so")
+_lib: ctypes.CDLL | None = None
+_err: str | None = None
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _err
+    if _lib is not None or _err is not None:
+        return _lib
+    try:
+        if (not _LIB.exists()
+                or _LIB.stat().st_mtime < _SRC.stat().st_mtime):
+            # build to a per-pid temp and atomically rename: concurrent
+            # first-use builders (pytest workers, a bench beside a
+            # training job) must never let a reader dlopen a
+            # partially-written library.
+            import os
+            tmp = _LIB.with_name(f".{_LIB.name}.{os.getpid()}")
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp),
+                 str(_SRC)],
+                check=True, capture_output=True, text=True, timeout=120)
+            os.replace(tmp, _LIB)
+        lib = ctypes.CDLL(str(_LIB))
+        lib.dts_zipf_fill.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_uint64]
+        lib.dts_pack_windows.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.dts_pack_windows.restype = ctypes.c_int64
+        lib.dts_shuffle_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_uint64]
+        _lib = lib
+    except Exception as e:  # noqa: BLE001 — degrade to the numpy twins
+        _err = f"{type(e).__name__}: {e}"
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> str | None:
+    """Why the native engine is unavailable (None when it is)."""
+    _load()
+    return _err
+
+
+def _i32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def synthetic_token_stream(num_tokens: int, vocab_size: int,
+                           seed: int = 42) -> np.ndarray:
+    """Native twin of ``packing.synthetic_token_stream`` (same Zipf law,
+    its own deterministic stream)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native data engine unavailable: {_err}")
+    out = np.empty(num_tokens, np.int32)
+    lib.dts_zipf_fill(_i32ptr(out), num_tokens, vocab_size, seed)
+    return out
+
+
+def pack_tokens(tokens: np.ndarray, seq_len: int):
+    """Native twin of ``packing.pack_tokens`` — identical outputs."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native data engine unavailable: {_err}")
+    tokens = np.ascontiguousarray(np.asarray(tokens).reshape(-1),
+                                  np.int32)
+    window = seq_len + 1
+    n = len(tokens) // window
+    if n == 0:
+        raise ValueError(f"stream of {len(tokens)} tokens too short for "
+                         f"one window of {window}")
+    inputs = np.empty((n, seq_len), np.int32)
+    labels = np.empty((n, seq_len), np.int32)
+    got = lib.dts_pack_windows(_i32ptr(tokens), len(tokens), seq_len,
+                               _i32ptr(inputs), _i32ptr(labels))
+    assert got == n, (got, n)
+    return inputs, labels
+
+
+def shuffle_indices(n: int, seed: int = 0) -> np.ndarray:
+    """Seeded Fisher–Yates permutation of [0, n) (epoch shuffles)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native data engine unavailable: {_err}")
+    out = np.empty(n, np.int64)
+    lib.dts_shuffle_indices(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, seed)
+    return out

@@ -122,15 +122,6 @@ def grouped_drop_fraction(expert: jax.Array, n_experts: int,
     return jnp.mean((jnp.max(pos, axis=-1) >= capg).astype(jnp.float32))
 
 
-def _route_top1(x2d, w_router):
-    """(N, H) tokens → (gate (N,), expert (N,), probs (N, E))."""
-    logits = (x2d @ w_router).astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
-    return gate, expert, probs
-
-
 def _route_topk(x2d, w_router, k: int):
     """(N, H) tokens → (gates (N, k), experts (N, k), probs (N, E)).
     k = 1 keeps the Switch convention (gate = raw top prob); k ≥ 2
@@ -348,7 +339,8 @@ def moe_reference(params: MoEParams, x, *, capacity_factor: float = 2.0):
     E = params.w_router.shape[1]
     cap = int(-(-N * capacity_factor // E))
     x2d = x.reshape(N, H)
-    gate, expert, _ = _route_top1(x2d, params.w_router)
+    gates, experts, _ = _route_topk(x2d, params.w_router, 1)
+    gate, expert = gates[:, 0], experts[:, 0]
     onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1
     kept = ((pos < cap) & (onehot > 0)).any(axis=1)

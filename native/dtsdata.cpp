@@ -1,0 +1,134 @@
+// Native data engine for the packed-LM pipeline.
+//
+// The reference rides torch's C++-backed DataLoader for its host-side
+// data path; this is the TPU build's native equivalent for the pieces
+// that are actually hot on the host: the seeded Zipfian synthetic token
+// stream (alias-method sampling — numpy's choice() over a 128k-vocab
+// probability vector does a binary search per token), the window
+// packer, and epoch shuffles.  Exposed as a plain C ABI consumed via
+// ctypes (distributed_training_sandbox_tpu/data/native.py) — no
+// pybind11 dependency.
+//
+// Determinism contract: every function is a pure function of its
+// arguments incl. the seed (splitmix64 → xoshiro256**), identical
+// across runs and hosts.  The native Zipf stream is NOT bit-identical
+// to numpy's Generator.choice — it is its own documented deterministic
+// stream (tests pin determinism and distribution shape, and exact
+// equality for the packer, which is pure arithmetic).
+//
+// Build: g++ -O3 -shared -fPIC -o libdtsdata.so dtsdata.cpp
+// (data/native.py does this on first use and caches the .so).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ----------------------------------------------------------------- rng
+
+static inline uint64_t splitmix64(uint64_t &x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  uint64_t z = x;
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+struct Xoshiro {
+  uint64_t s[4];
+  explicit Xoshiro(uint64_t seed) {
+    for (int i = 0; i < 4; i++) s[i] = splitmix64(seed);
+  }
+  static inline uint64_t rotl(uint64_t x, int k) {
+    return (x << k) | (x >> (64 - k));
+  }
+  inline uint64_t next() {
+    uint64_t result = rotl(s[1] * 5, 7) * 9;
+    uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+  // uniform double in [0, 1)
+  inline double u01() { return (next() >> 11) * 0x1.0p-53; }
+  // uniform integer in [0, n)
+  inline uint64_t below(uint64_t n) { return next() % n; }
+};
+
+// ------------------------------------------------- zipf via alias table
+
+// Fill out[0..n) with token ids in [0, vocab) drawn from the Zipfian
+// unigram distribution p_i ∝ 1/(i+1) (the same law
+// data/packing.py:synthetic_token_stream uses).  Walker alias method:
+// O(vocab) build, O(1) per sample.
+void dts_zipf_fill(int32_t *out, int64_t n, int32_t vocab, uint64_t seed) {
+  std::vector<double> prob(vocab);
+  double norm = 0.0;
+  for (int32_t i = 0; i < vocab; i++) {
+    prob[i] = 1.0 / (double)(i + 1);
+    norm += prob[i];
+  }
+  // scaled probabilities (mean 1) and the alias tables
+  std::vector<double> q(vocab);
+  std::vector<int32_t> alias(vocab, 0);
+  std::vector<int32_t> small, large;
+  small.reserve(vocab);
+  large.reserve(vocab);
+  for (int32_t i = 0; i < vocab; i++) {
+    q[i] = prob[i] / norm * (double)vocab;
+    (q[i] < 1.0 ? small : large).push_back(i);
+  }
+  while (!small.empty() && !large.empty()) {
+    int32_t s = small.back(); small.pop_back();
+    int32_t l = large.back(); large.pop_back();
+    alias[s] = l;
+    q[l] = (q[l] + q[s]) - 1.0;
+    (q[l] < 1.0 ? small : large).push_back(l);
+  }
+  while (!large.empty()) { q[large.back()] = 1.0; large.pop_back(); }
+  while (!small.empty()) { q[small.back()] = 1.0; small.pop_back(); }
+
+  Xoshiro rng(seed);
+  for (int64_t i = 0; i < n; i++) {
+    int32_t col = (int32_t)rng.below((uint64_t)vocab);
+    out[i] = (rng.u01() < q[col]) ? col : alias[col];
+  }
+}
+
+// --------------------------------------------------------- window pack
+
+// Concatenated stream → (inputs, labels), both (n_windows, seq_len),
+// stride seq_len+1, ragged tail dropped — byte-for-byte the rule of
+// data/packing.py:pack_tokens (reference fsdp/utils.py:58-89).
+// Returns n_windows.  inputs/labels must hold n_windows*seq_len ints.
+int64_t dts_pack_windows(const int32_t *stream, int64_t n_tokens,
+                         int64_t seq_len, int32_t *inputs,
+                         int32_t *labels) {
+  const int64_t window = seq_len + 1;
+  const int64_t n_windows = n_tokens / window;
+  for (int64_t w = 0; w < n_windows; w++) {
+    const int32_t *src = stream + w * window;
+    std::memcpy(inputs + w * seq_len, src, seq_len * sizeof(int32_t));
+    std::memcpy(labels + w * seq_len, src + 1, seq_len * sizeof(int32_t));
+  }
+  return n_windows;
+}
+
+// ------------------------------------------------------- epoch shuffle
+
+// out[0..n) = a seeded Fisher–Yates permutation of [0, n).
+void dts_shuffle_indices(int64_t *out, int64_t n, uint64_t seed) {
+  for (int64_t i = 0; i < n; i++) out[i] = i;
+  Xoshiro rng(seed);
+  for (int64_t i = n - 1; i > 0; i--) {
+    int64_t j = (int64_t)rng.below((uint64_t)(i + 1));
+    int64_t t = out[i]; out[i] = out[j]; out[j] = t;
+  }
+}
+
+}  // extern "C"

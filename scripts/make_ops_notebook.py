@@ -66,8 +66,13 @@ def helper_cell() -> str:
     src = (REPO / "scripts" / "ops_demo.py").read_text()
     helpers = src.split('SEP = "─" * 72', 1)[1]
     helpers = helpers.split("def main() -> dict:", 1)[0]
-    return ('import io, sys\nfrom pathlib import Path\n'
-            f'sys.path.insert(0, {str(REPO)!r})\n\n'
+    # repo-root discovery at RUN time (no baked absolute paths: the
+    # committed notebook must work from any clone location)
+    return ('import io, sys\n'
+            'from pathlib import Path\n'
+            'root = next(p for p in [Path.cwd(), *Path.cwd().parents]\n'
+            '            if (p / "distributed_training_sandbox_tpu").exists())\n'
+            'sys.path.insert(0, str(root))\n\n'
             'SEP = "─" * 72' + helpers.rstrip())
 
 

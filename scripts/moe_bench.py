@@ -33,6 +33,8 @@ GRID = [
     ({"moe_dispatch": "grouped", "moe_capacity_factor": 1.0}, 4),
     ({"moe_dispatch": "grouped", "moe_capacity_factor": 1.25,
       "matmul_precision": "int8_bwd"}, 4),
+    ({"moe_dispatch": "grouped", "moe_capacity_factor": 1.0,
+      "matmul_precision": "int8_bwd"}, 4),
     ({"moe_dispatch": "grouped"}, 2),
     ({"moe_dispatch": "grouped", "moe_top_k": 2,
       "moe_capacity_factor": 1.0}, 4),

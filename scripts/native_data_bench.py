@@ -1,0 +1,84 @@
+"""Native vs numpy data-engine benchmark (host-side, no devices).
+
+Times the three host hot spots of the packed-LM pipeline — the Zipfian
+synthetic sampler, the window packer, the epoch shuffle — numpy twins
+(``data/packing.py``) vs the C++ engine (``native/dtsdata.cpp``).
+Writes ``data_results/native_data_bench.json`` and prints the table.
+
+    python scripts/native_data_bench.py [--tokens 20000000] [--vocab 128256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+from distributed_training_sandbox_tpu.data import native, packing  # noqa: E402
+
+
+def timeit(f, *args, reps=3):
+    best = float("inf")
+    out = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = f(*args)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--tokens", type=int, default=20_000_000)
+    p.add_argument("--vocab", type=int, default=128_256)
+    p.add_argument("--seq", type=int, default=8192)
+    p.add_argument("--out-dir", default="data_results")
+    args = p.parse_args(argv)
+
+    if not native.available():
+        raise SystemExit(f"native engine unavailable: "
+                         f"{native.build_error()}")
+
+    rows = []
+
+    t_np, stream = timeit(packing.synthetic_token_stream, args.tokens,
+                          args.vocab, 42)
+    t_cc, _ = timeit(native.synthetic_token_stream, args.tokens,
+                     args.vocab, 42)
+    rows.append({"op": f"zipf sample ({args.tokens / 1e6:.0f}M tokens, "
+                       f"vocab {args.vocab})",
+                 "numpy_s": round(t_np, 3), "native_s": round(t_cc, 3),
+                 "speedup": round(t_np / t_cc, 1)})
+
+    t_np, _ = timeit(packing.pack_tokens, stream, args.seq)
+    t_cc, _ = timeit(native.pack_tokens, stream, args.seq)
+    rows.append({"op": f"pack windows (seq {args.seq})",
+                 "numpy_s": round(t_np, 4), "native_s": round(t_cc, 4),
+                 "speedup": round(t_np / t_cc, 1)})
+
+    n = args.tokens // (args.seq + 1)
+    rng = np.random.default_rng(0)
+    t_np, _ = timeit(lambda: rng.permutation(n))
+    t_cc, _ = timeit(native.shuffle_indices, n, 0)
+    rows.append({"op": f"epoch shuffle ({n} windows)",
+                 "numpy_s": round(t_np, 5), "native_s": round(t_cc, 5),
+                 "speedup": round(t_np / t_cc, 1)})
+
+    print("| op | numpy s | native s | speedup |\n|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['op']} | {r['numpy_s']} | {r['native_s']} | "
+              f"{r['speedup']}× |")
+    out = Path(args.out_dir)
+    out.mkdir(exist_ok=True)
+    (out / "native_data_bench.json").write_text(json.dumps(rows, indent=1))
+    print(f"[native-data] wrote {out / 'native_data_bench.json'}")
+
+
+if __name__ == "__main__":
+    main()

@@ -167,6 +167,38 @@ def test_3d_dp_sp_ep_moe_step(mesh8):
     assert counts["all_to_all"] >= 4, counts           # expert dispatch
 
 
+def test_3d_dp_sp_ep_moe_step_zigzag(mesh8):
+    """The 3-D MoE step with the ZIGZAG ring layout: the cfg's
+    ring_layout survives the step builder's ring/sp replacement, the
+    batch arrives zigzag-shuffled, and the sharded loss at no-drop
+    capacity still equals the all-local oracle on the natural-order
+    batch (token means are permutation invariant)."""
+    from distributed_training_sandbox_tpu.parallel import sequence
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("dp", "sp", "ep"))
+    cfg = dataclasses.replace(TINY_MOE, moe_capacity_factor=8.0,
+                              moe_aux_weight=0.0, ring_layout="zigzag")
+    params = T.init_params(jax.random.PRNGKey(16), cfg)
+    batch = _batch(cfg, B=4, S=64, seed=17)
+
+    local_cfg = dataclasses.replace(cfg, ep_axis=None,
+                                    ring_layout="contiguous")
+    chunks = [float(T.lm_loss(params, (batch[0][i:i + 1],
+                                       batch[1][i:i + 1]), local_cfg))
+              for i in range(4)]
+    want = float(np.mean(chunks))
+
+    zbatch = tuple(sequence.zigzag_shuffle(x, 2) for x in batch)
+    shards = expert.shard_moe_lm_params(params, mesh)
+    opt = init_fsdp_opt_state(shards)
+    step = expert.make_moe_lm_train_step(shards, cfg, mesh,
+                                         sp_axis="sp", donate=False)
+    _, _, loss0 = step(shards, opt, zbatch)
+    assert float(loss0) == pytest.approx(want, abs=2e-4), (float(loss0),
+                                                           want)
+
+
 def test_moe_step_validates_expert_divisibility(mesh_dp_ep):
     cfg = dataclasses.replace(TINY_MOE, n_experts=6)  # 6 % 4 != 0
     params = T.init_params(jax.random.PRNGKey(5), cfg)

@@ -1,0 +1,62 @@
+"""Native (C++) data engine: builds, matches the numpy twins' contracts.
+
+``native/dtsdata.cpp`` is the TPU build's torch-DataLoader analogue for
+the host-side hot spots.  Contracts pinned here: the packer is EXACTLY
+the numpy rule (pure arithmetic — equality); the Zipf sampler is
+deterministic per seed with the right distribution shape (its own
+stream, documented); shuffles are seeded permutations.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_training_sandbox_tpu.data import packing
+from distributed_training_sandbox_tpu.data import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native engine unavailable: {native.build_error()}")
+
+
+def test_pack_tokens_equals_numpy_exactly():
+    stream = np.arange(1000, dtype=np.int32) % 97
+    for seq_len in (7, 32, 64):
+        ni, nl = native.pack_tokens(stream, seq_len)
+        pi, pl = packing.pack_tokens(stream, seq_len)
+        np.testing.assert_array_equal(ni, pi)
+        np.testing.assert_array_equal(nl, pl)
+    with pytest.raises(ValueError, match="too short"):
+        native.pack_tokens(np.arange(3, dtype=np.int32), 10)
+
+
+def test_zipf_stream_deterministic_and_zipfian():
+    a = native.synthetic_token_stream(200_000, 1000, seed=7)
+    b = native.synthetic_token_stream(200_000, 1000, seed=7)
+    np.testing.assert_array_equal(a, b)                  # per-seed exact
+    c = native.synthetic_token_stream(200_000, 1000, seed=8)
+    assert (a != c).any()                                # seed matters
+    assert a.min() >= 0 and a.max() < 1000
+    # distribution shape: empirical unigram frequencies track 1/(i+1)
+    counts = np.bincount(a, minlength=1000).astype(np.float64)
+    emp = counts / counts.sum()
+    ranks = np.arange(1, 1001, dtype=np.float64)
+    want = (1 / ranks) / (1 / ranks).sum()
+    # head of the distribution carries the mass — compare there
+    np.testing.assert_allclose(emp[:50], want[:50], rtol=0.15)
+
+
+def test_shuffle_is_seeded_permutation():
+    p = native.shuffle_indices(10_000, seed=3)
+    np.testing.assert_array_equal(np.sort(p), np.arange(10_000))
+    np.testing.assert_array_equal(p, native.shuffle_indices(10_000, 3))
+    assert (p != native.shuffle_indices(10_000, seed=4)).any()
+
+
+def test_make_packed_dataset_native_engine():
+    ids, labels = packing.make_packed_dataset(
+        64, 512, num_tokens=10 * 65, source="synthetic", engine="native")
+    assert ids.shape == labels.shape == (10, 64)
+    # causal-window contract holds regardless of engine
+    np.testing.assert_array_equal(ids[:, 1:], labels[:, :-1])
+    with pytest.raises(ValueError, match="engine"):
+        packing.make_packed_dataset(64, 512, engine="rust")
