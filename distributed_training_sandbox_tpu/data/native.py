@@ -42,11 +42,15 @@ def _load() -> ctypes.CDLL | None:
             # partially-written library.
             import os
             tmp = _LIB.with_name(f".{_LIB.name}.{os.getpid()}")
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp),
-                 str(_SRC)],
-                check=True, capture_output=True, text=True, timeout=120)
-            os.replace(tmp, _LIB)
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", str(tmp),
+                     str(_SRC)],
+                    check=True, capture_output=True, text=True,
+                    timeout=120)
+                os.replace(tmp, _LIB)
+            finally:
+                tmp.unlink(missing_ok=True)  # leak nothing on failure
         lib = ctypes.CDLL(str(_LIB))
         lib.dts_zipf_fill.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,

@@ -110,7 +110,8 @@ def make_packed_dataset(seq_len: int, vocab_size: int, *,
 
     engine: "numpy" (default — the committed benchmarks' deterministic
     stream) or "native" (the C++ engine, ``data/native.py``: same Zipf
-    law and packing rule, ~2 orders faster sampling, its OWN seeded
+    law and packing rule, ~10× faster sampling — measured,
+    ``data_results/native_data_bench.json`` — and its OWN seeded
     stream — pick per run, not per step).
     """
     if source not in ("tinystories", "synthetic", "auto"):

@@ -1,7 +1,7 @@
 from .mlp import init_mlp, mlp_apply, zero_toy_mlp, pp_toy_mlp  # noqa: F401
 from .transformer import (  # noqa: F401
     TransformerConfig, SMOLLM3_3B, SMOLLM3_3B_L8, SMOLLM3_350M, TINY_LM,
-    QWEN3_4B, QWEN3_4B_L6,
+    QWEN3_4B, QWEN3_4B_L6, LLAMA32_1B, LLAMA31_8B,
     init_params, forward, lm_loss, model_flops_per_token)
 from .classifier import (  # noqa: F401
     init_classifier_params, classifier_logits, classification_loss,
@@ -14,5 +14,7 @@ MODEL_REGISTRY = {
     "smollm3-350m": "SMOLLM3_350M",
     "qwen3-4b": "QWEN3_4B",
     "qwen3-4b-l6": "QWEN3_4B_L6",
+    "llama3.2-1b": "LLAMA32_1B",
+    "llama3.1-8b": "LLAMA31_8B",
     "tiny": "TINY_LM",
 }

@@ -178,6 +178,21 @@ QWEN3_4B_L6 = TransformerConfig(
     head_dim=128, rope_theta=1_000_000.0, nope_interval=0,
     attention_impl="flash", loss_vocab_chunk=15_194)
 
+# Llama-3.2-1B / Llama-3.1-8B geometry classes — the remaining fp8
+# benchmark target families (``fp8/fp8_benchmark.py:34-37``).  The 1B
+# trains WHOLE on one 16 GB v5e (1.24 B params); the 8B is the
+# multi-chip configuration (FSDP/TP it over a mesh).
+LLAMA32_1B = TransformerConfig(
+    vocab_size=128_256, hidden_size=2048, intermediate_size=8192,
+    num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
+    head_dim=64, rope_theta=500_000.0, nope_interval=0,
+    attention_impl="flash", loss_vocab_chunk=16_032)
+LLAMA31_8B = TransformerConfig(
+    vocab_size=128_256, hidden_size=4096, intermediate_size=14_336,
+    num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+    head_dim=128, rope_theta=500_000.0, nope_interval=0,
+    tie_word_embeddings=False)
+
 # Smaller siblings for 1-chip benches and CI (same shape family).
 SMOLLM3_350M = TransformerConfig(
     vocab_size=49_152, hidden_size=960, intermediate_size=2560,

@@ -11,6 +11,7 @@ from .collectives import (  # noqa: F401
     axis_size,
     smap,
     tree_all_reduce,
+    tree_all_gather,
 )
 from .hlo import count_collectives, lowered_text  # noqa: F401
 from . import quant  # noqa: F401

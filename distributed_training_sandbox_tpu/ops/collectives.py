@@ -151,3 +151,21 @@ def tree_all_reduce(tree: Any, axis_name: str, *, mean: bool = True):
     all_reduce loop (``DDP/ddp.py:43-47``) as one tree_map.  One collective
     per leaf in the HLO, preserving trace-count parity."""
     return jax.tree.map(lambda g: all_reduce(g, axis_name, mean=mean), tree)
+
+
+def tree_all_gather(tree: Any, axis_name: str, *, axis: int = 0,
+                    tiled: bool = True):
+    """Per-leaf all_gather of an arbitrarily nested pytree — the twin of
+    the reference's recursive structured ``gather()``
+    (``DDP/training_utils/utils.py:137-198``), which walks nested
+    containers all-gathering every tensor.  Pytrees make the recursion a
+    tree_map; non-array leaves pass through untouched, as the
+    reference's non-tensor branches do; 0-d leaves gather into a
+    (world_size,) vector (the reference stacks scalars the same way)."""
+    def leaf(x):
+        if not hasattr(x, "ndim"):
+            return x
+        if x.ndim == 0:
+            return all_gather(x[None], axis_name, axis=0, tiled=True)
+        return all_gather(x, axis_name, axis=axis, tiled=tiled)
+    return jax.tree.map(leaf, tree)

@@ -95,6 +95,30 @@ def test_tree_all_reduce_counts(mesh8):
     assert counts["all_reduce"] == 12
 
 
+def test_tree_all_gather_structured(mesh8):
+    """The structured-gather twin (reference utils.py:137-198): nested
+    containers all-gather per tensor leaf; non-array leaves pass
+    through."""
+    from distributed_training_sandbox_tpu.ops import tree_all_gather
+
+    def body(t):
+        # non-array leaves ride inside the mapped fn (shard_map can't
+        # carry them across its boundary): identity pass-through is
+        # checked at trace time.
+        full = {"arrays": t, "tag": "static"}
+        out = tree_all_gather(full, "dp")
+        assert out["tag"] == "static"
+        return out["arrays"]
+
+    tree = {"a": jnp.arange(8.0), "nested": [jnp.ones((8, 2))]}
+    f = smap(body, mesh8,
+             ({"a": P("dp"), "nested": [P("dp")]},),
+             {"a": P(), "nested": [P()]})
+    out = f(tree)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(8.0))
+    assert out["nested"][0].shape == (8, 2)
+
+
 def test_count_collectives_kinds(mesh8):
     def f(x):
         g = all_gather(x, "dp")

@@ -135,3 +135,51 @@ def test_adam_preserves_param_dtype():
     assert new_state.mu["w"].dtype == jnp.bfloat16
     new_params, _ = optim.adam_update(grads, new_state, new_params)
     assert new_params["w"].dtype == jnp.bfloat16
+
+
+def test_llama8b_shards_and_compiles_aot(mesh8):
+    """The 8-billion-parameter config (the reference fp8 benchmark's
+    largest target family) lowers and compiles FULLY SHARDED over an
+    8-device mesh without ever materializing a weight: abstract avals
+    through jax.eval_shape + AOT lower/compile.  Proof the sharding
+    rules scale to the multi-chip model, plus a per-device memory plan
+    far below one device's worth of the unsharded model."""
+    import dataclasses
+
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.parallel import fsdp
+
+    cfg = dataclasses.replace(T.LLAMA31_8B, attention_impl="xla",
+                              loss_vocab_chunk=16_032)
+    abstract = jax.eval_shape(
+        lambda k: T.init_params(k, cfg), jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree.leaves(abstract))
+    assert n_params > 8e9
+
+    specs = fsdp.fsdp_specs(abstract)
+    shard_avals = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype,
+            sharding=jax.sharding.NamedSharding(mesh8, s)),
+        abstract, specs,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+    opt_avals = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                       sharding=l.sharding),
+        fsdp.init_fsdp_opt_state(shard_avals))
+    step = fsdp.make_fsdp_train_step(shard_avals, cfg, mesh8,
+                                     donate=False)
+    ids = jax.ShapeDtypeStruct((8, 128), jnp.int32)
+    compiled = step.lower(shard_avals, opt_avals, (ids, ids)).compile()
+    ma = compiled.memory_analysis()
+    # memory_analysis() is already PER DEVICE for the SPMD executable
+    # (arguments are the shard shapes) — no further division.  The
+    # sharding proof is the ARGUMENT plan: an unsharded 8B bf16
+    # (params + Adam mu/nu) would be ~45 GB per device; 1/8 shards are
+    # ~5.6 GB.  Temps are excluded from the bound — the CPU-sim
+    # backend's buffer planning is far looser than TPU's (measured
+    # ~15.5 GB total here vs the 3B flagship actually fitting 16 GB on
+    # chip) and would make the assertion about the wrong thing.
+    args_gb = ma.argument_size_in_bytes / 2**30
+    assert args_gb < 10, args_gb

@@ -57,19 +57,69 @@ assert local == 6, local
 """
 
 
+TRAIN_WORKER = r"""
+import os, sys
+port, pid = sys.argv[1], int(sys.argv[2])
+sys.path.insert(0, sys.argv[3])
+
+from distributed_training_sandbox_tpu.utils import use_cpu_devices
+use_cpu_devices(4)
+from distributed_training_sandbox_tpu.utils.mesh import (
+    make_mesh, setup_distributed)
+
+setup_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+
+import dataclasses
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2 and len(jax.devices()) == 8
+
+from distributed_training_sandbox_tpu.models import transformer as T
+from distributed_training_sandbox_tpu.parallel import fsdp
+
+mesh = make_mesh({"dp": 8}, register=False)
+cfg = dataclasses.replace(T.TINY_LM, num_hidden_layers=2)
+# identical seeds on both processes -> identical host values; device_put
+# with a global sharding then places each process's local shards
+params = T.init_params(jax.random.PRNGKey(0), cfg)
+shards = fsdp.shard_params_fsdp(params, mesh)
+opt = fsdp.init_fsdp_opt_state(shards)
+step = fsdp.make_fsdp_train_step(shards, cfg, mesh, donate=False)
+
+ids_np = np.random.default_rng(1).integers(
+    0, cfg.vocab_size, (8, 32), dtype=np.int32)
+batch = tuple(
+    jax.make_array_from_callback(
+        (8, 32), NamedSharding(mesh, P("dp")),
+        lambda idx, a=a: a[idx])
+    for a in (ids_np, np.roll(ids_np, -1, axis=1)))
+
+losses = []
+for _ in range(2):
+    shards, opt, loss = step(shards, opt, batch)
+    losses.append(float(np.asarray(loss.addressable_data(0))))
+assert all(np.isfinite(l) for l in losses), losses
+# shortest-roundtrip reprs: string equality == bitwise equality
+print(f"RESULT pid={pid} losses={losses[0]!r},{losses[1]!r}",
+      flush=True)
+"""
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
-def test_two_process_psum():
-    port = _free_port()
+def _spawn_two(worker: str, port: int):
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_PROCESSES")}
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", WORKER, str(port), str(pid), str(REPO)],
+            [sys.executable, "-c", worker, str(port), str(pid), str(REPO)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for pid in range(2)
@@ -77,12 +127,34 @@ def test_two_process_psum():
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=420)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
         outs.append(out)
+    return procs, outs
+
+
+def test_two_process_fsdp_train_step():
+    """An actual TRAINING step spanning two OS processes: the FSDP
+    choreography (per-layer gathers, reduce-scatters, loss pmean) runs
+    over one 8-device mesh whose halves live in different processes —
+    the torchrun-contract twin exercised end-to-end, not just a psum.
+    Both processes must see the SAME replicated loss."""
+    procs, outs = _spawn_two(TRAIN_WORKER, _free_port())
+    results = []
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        line = [l for l in out.splitlines()
+                if l.startswith(f"RESULT pid={pid}")]
+        assert line, out
+        results.append(line[0].split("losses=")[1])
+    assert results[0] == results[1], results  # replicated loss agrees
+
+
+def test_two_process_psum():
+    procs, outs = _spawn_two(WORKER, _free_port())
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert f"RESULT pid={pid} sum=6" in out, out
