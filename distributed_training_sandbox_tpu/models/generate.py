@@ -1,0 +1,159 @@
+"""Autoregressive decoding with a KV cache — the inference face of the
+transformer.
+
+The reference is a training course and never decodes (its models run
+with ``use_cache=False``, ``fsdp/train_fsdp.py:61-64``); a framework a
+user can switch to needs the other half.  TPU-shaped design:
+
+  * the cache is a fixed-capacity pytree ``(L, B, S_max, n_kv, hd)`` —
+    static shapes end to end, so the whole decode loop is ONE compiled
+    ``lax.scan`` (no per-token retrace, no dynamic shapes);
+  * prefill = the normal batched forward (MXU-friendly) that also
+    writes the cache via ``lax.dynamic_update_slice``;
+  * decode steps run single-query attention against the cache with a
+    length mask (positions ≥ the current length contribute nothing);
+  * greedy or temperature sampling, PRNG threaded through the scan.
+
+Works under any single-device jit; GQA, RoPE(+NoPE schedule) and the
+tied unembedding reuse the training model's code so the two paths
+cannot drift.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import transformer as T
+
+
+class KVCache(NamedTuple):
+    k: jax.Array      # (L, B, S_max, n_kv, hd)
+    v: jax.Array      # (L, B, S_max, n_kv, hd)
+    length: jax.Array  # () int32 — tokens currently cached
+
+
+def init_cache(cfg: T.TransformerConfig, batch: int,
+               max_len: int) -> KVCache:
+    L, nkv, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                  cfg.resolved_head_dim)
+    shape = (L, batch, max_len, nkv, hd)
+    return KVCache(k=jnp.zeros(shape, cfg.dtype),
+                   v=jnp.zeros(shape, cfg.dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope, li,
+                       cache: KVCache, start):
+    """One decoder layer that READS/WRITES the cache: the training
+    layer's SHARED projection/MLP helpers (``transformer._qkv_proj`` /
+    ``_mlp_block`` — one implementation, no drift) with attention run
+    against [0, start + S) of the cache instead of the local chunk.
+    x: (B, S, H) with S = prefill length or 1."""
+    B, S, H = x.shape
+    hd = cfg.resolved_head_dim
+    nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    dense = T._dense(cfg)
+
+    r = T.rms_norm(x, layer["ln1"], cfg.rms_norm_eps)
+    q, k, v = T._qkv_proj(r, layer, cfg=cfg, cos=cos, sin=sin,
+                          use_rope=use_rope)
+
+    ck = lax.dynamic_update_slice(cache.k[li], k, (0, start, 0, 0))
+    cv = lax.dynamic_update_slice(cache.v[li], v, (0, start, 0, 0))
+    new_cache = (ck, cv)
+
+    # attention over the cache: visible = pos_kv <= pos_q (absolute)
+    S_max = ck.shape[1]
+    rep = nq // nkv
+    kf = jnp.repeat(ck, rep, axis=2) if rep != 1 else ck
+    vf = jnp.repeat(cv, rep, axis=2) if rep != 1 else cv
+    scores = jnp.einsum("bqnh,bknh->bnqk", q.astype(jnp.float32),
+                        kf.astype(jnp.float32)) / math.sqrt(hd)
+    pos_q = start + jnp.arange(S)
+    pos_kv = jnp.arange(S_max)
+    vis = pos_kv[None, :] <= pos_q[:, None]
+    scores = jnp.where(vis[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bnqk,bknh->bqnh", probs,
+                      vf.astype(jnp.float32)).astype(x.dtype)
+    x = x + dense(attn.reshape(B, S, nq * hd), layer["wo"])
+
+    r = T.rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
+    mlp, _aux = T._mlp_block(r, layer, cfg=cfg)
+    return x + mlp, new_cache
+
+
+def _forward_cached(params, ids, cfg, cache: KVCache, start):
+    """ids (B, S) → (last-position logits (B, V) fp32, cache') using /
+    refreshing the cache; ``start`` = absolute position of ids[:, 0].
+    Only the LAST position's logits are computed — decoding never needs
+    the rest, and a full (B, S, vocab) fp32 prefill buffer would be the
+    exact memory spike the streamed training loss exists to avoid."""
+    B, S = ids.shape
+    x = params["embed"].astype(cfg.dtype)[ids]
+    cos, sin = T._rope_tables(S, cfg.resolved_head_dim, cfg.rope_theta,
+                              start)
+    flags = T._rope_flags(cfg)
+
+    def body(x, scanned):
+        li, layer, use_rope = scanned
+        x, (ck, cv) = _cached_layer_body(
+            x, layer, cfg=cfg, cos=cos, sin=sin, use_rope=use_rope,
+            li=li, cache=cache, start=start)
+        return x, (ck, cv)
+
+    idx = jnp.arange(cfg.num_hidden_layers)
+    x, (ks, vs) = lax.scan(body, x, (idx, params["layers"], flags))
+    x = T.rms_norm(x[:, -1:], params["final_norm"], cfg.rms_norm_eps)
+    logits = (x @ T._output_embedding(params, cfg).T)[:, 0]
+    new = KVCache(k=ks, v=vs, length=start + S)
+    return logits.astype(jnp.float32), new
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
+                                   "temperature"))
+def generate(params, prompt_ids, cfg: T.TransformerConfig, *,
+             max_new_tokens: int = 32, temperature: float = 0.0,
+             rng: jax.Array | None = None):
+    """Decode ``max_new_tokens`` after ``prompt_ids`` (B, S_prompt).
+
+    temperature 0 = greedy argmax; > 0 = categorical sampling — ``rng``
+    is then REQUIRED (a silent default key would return identical
+    "samples" on every call).  Returns (B, max_new_tokens) int32.  One
+    prefill forward + one scanned decode loop — two compiled programs
+    total, static shapes throughout.
+    """
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 samples stochastically: pass "
+                         "rng=jax.random.PRNGKey(...) explicitly")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)   # unused by greedy picks
+    B, S0 = prompt_ids.shape
+    S_max = S0 + max_new_tokens
+    cache = init_cache(cfg, B, S_max)
+    logits, cache = _forward_cached(params, prompt_ids, cfg, cache, 0)
+
+    def pick(logits_1, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits_1, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits_1 / temperature, axis=-1).astype(jnp.int32)
+
+    tok0 = pick(logits, rng)
+
+    def step(carry, key):
+        tok, cache = carry
+        logits, cache = _forward_cached(params, tok[:, None], cfg,
+                                        cache, cache.length)
+        nxt = pick(logits, key)
+        return (nxt, cache), tok
+
+    keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens)
+    (_, _), toks = lax.scan(step, (tok0, cache), keys)
+    return toks.swapaxes(0, 1)   # (B, max_new_tokens)

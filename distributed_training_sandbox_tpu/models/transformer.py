@@ -344,6 +344,45 @@ def _dense(cfg: TransformerConfig):
     return resolve_quantized_dense(cfg.matmul_precision)
 
 
+def _qkv_proj(r, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
+              tp: int = 1):
+    """Normed residual → RoPE'd (q, k, v) — the projection math shared
+    by the training layer and the KV-cache decode layer
+    (``models/generate.py``), so the two paths cannot drift."""
+    B, S, _ = r.shape
+    hd = cfg.resolved_head_dim
+    nq = cfg.num_attention_heads // tp
+    nkv = cfg.num_key_value_heads // tp
+    dense = _dense(cfg)
+    q = dense(r, layer["wq"]).reshape(B, S, nq, hd)
+    k = dense(r, layer["wk"]).reshape(B, S, nkv, hd)
+    v = dense(r, layer["wv"]).reshape(B, S, nkv, hd)
+    q = jnp.where(use_rope, apply_rope(q, cos, sin), q)
+    k = jnp.where(use_rope, apply_rope(k, cos, sin), k)
+    return q, k, v
+
+
+def _mlp_block(r, layer, *, cfg: TransformerConfig):
+    """Post-attention MLP (dense SwiGLU or top-k MoE) on the normed
+    residual — shared by training and decode.  Returns (mlp, aux)."""
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.n_experts:
+        from ..parallel.expert import moe_mlp
+        mlp, aux = moe_mlp(r, layer["w_router"], layer["w_gate"],
+                           layer["w_up"], layer["w_down"],
+                           axis=cfg.ep_axis,
+                           capacity_factor=cfg.moe_capacity_factor,
+                           dispatch=cfg.moe_dispatch,
+                           group_size=cfg.moe_group_size,
+                           top_k=cfg.moe_top_k,
+                           matmul_precision=cfg.matmul_precision)
+    else:
+        dense = _dense(cfg)
+        mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
+                    * dense(r, layer["w_up"]), layer["w_down"])
+    return mlp, aux
+
+
 def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
                 tp_axis: str | None = None):
     """One decoder layer.  ``layer`` holds this layer's (unstacked) params;
@@ -357,15 +396,11 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     hd = cfg.resolved_head_dim
     tp = lax.axis_size(tp_axis) if tp_axis else 1
     nq = cfg.num_attention_heads // tp
-    nkv = cfg.num_key_value_heads // tp
     dense = _dense(cfg)
 
     r = rms_norm(x, layer["ln1"], cfg.rms_norm_eps)
-    q = dense(r, layer["wq"]).reshape(B, S, nq, hd)
-    k = dense(r, layer["wk"]).reshape(B, S, nkv, hd)
-    v = dense(r, layer["wv"]).reshape(B, S, nkv, hd)
-    q = jnp.where(use_rope, apply_rope(q, cos, sin), q)
-    k = jnp.where(use_rope, apply_rope(k, cos, sin), k)
+    q, k, v = _qkv_proj(r, layer, cfg=cfg, cos=cos, sin=sin,
+                        use_rope=use_rope, tp=tp)
     scale = 1.0 / math.sqrt(hd)
     if cfg.attention_impl == "flash":
         attn = _attention_flash(q, k, v, scale).astype(x.dtype)
@@ -387,36 +422,18 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     x = x + attn_out
 
     r = rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
-    aux = jnp.zeros((), jnp.float32)
-    if cfg.n_experts:
-        if tp_axis and cfg.ep_axis:
-            raise ValueError("shard experts over ep OR split them over "
-                             "tp, not both (ep_axis and tp_axis set)")
-        from ..parallel.expert import moe_mlp
-        # Under TP each rank holds every expert's F/tp slice (tp_specs):
-        # routing/dispatch are replicated across the tp group (tokens and
-        # router are), the per-expert matmuls produce partial sums, and
-        # one psum after combine rejoins them — the Megatron row/column
-        # pairing applied inside each expert.
-        mlp, aux = moe_mlp(r, layer["w_router"], layer["w_gate"],
-                           layer["w_up"], layer["w_down"],
-                           axis=cfg.ep_axis,
-                           capacity_factor=cfg.moe_capacity_factor,
-                           dispatch=cfg.moe_dispatch,
-                           group_size=cfg.moe_group_size,
-                           top_k=cfg.moe_top_k,
-                           matmul_precision=cfg.matmul_precision)
-        if tp_axis:
-            from ..ops import collectives as C
-            from ..utils.profiling import scope
-            with scope("tp_moe_psum"):
-                mlp = C.all_reduce(mlp, tp_axis)
-    else:
-        mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))
-                    * dense(r, layer["w_up"]), layer["w_down"])
-        if tp_axis:
-            with scope("tp_mlp_psum"):
-                mlp = C.all_reduce(mlp, tp_axis)
+    if tp_axis and cfg.n_experts and cfg.ep_axis:
+        raise ValueError("shard experts over ep OR split them over "
+                         "tp, not both (ep_axis and tp_axis set)")
+    # Under TP each rank holds every expert's F/tp slice (tp_specs):
+    # routing/dispatch are replicated across the tp group (tokens and
+    # router are), the per-expert matmuls produce partial sums, and one
+    # psum after combine rejoins them — the Megatron row/column pairing
+    # applied inside each expert (dense MLP: the classic pairing).
+    mlp, aux = _mlp_block(r, layer, cfg=cfg)
+    if tp_axis:
+        with scope("tp_moe_psum" if cfg.n_experts else "tp_mlp_psum"):
+            mlp = C.all_reduce(mlp, tp_axis)
     return x + mlp, aux
 
 

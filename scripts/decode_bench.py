@@ -1,0 +1,73 @@
+"""Autoregressive decode throughput on the local chip.
+
+The inference face of the framework (models/generate.py): prefill one
+batch of prompts, then measure steady-state cached decode tokens/s on
+the flagship geometry.  Writes ``decode_results/decode_<platform>.json``.
+
+    python scripts/decode_bench.py [--batch 8] [--new 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="SMOLLM3_3B_L8")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt", type=int, default=128)
+    p.add_argument("--new", type=int, default=128)
+    p.add_argument("--out-dir", default="decode_results")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.models.generate import generate
+
+    cfg = getattr(T, args.model)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1),
+                                (args.batch, args.prompt), 0,
+                                cfg.vocab_size, jnp.int32)
+
+    # two windows — prefill+1 token vs prefill+N tokens — so the
+    # STEADY-STATE decode rate is (N−1)·B / (tN − t1), prefill excluded.
+    for n in (1, args.new):              # compile both programs first
+        np.asarray(generate(params, prompt, cfg, max_new_tokens=n))
+    p2 = jnp.roll(prompt, 1, axis=1)
+    t0 = time.perf_counter()
+    np.asarray(generate(params, p2, cfg, max_new_tokens=1))
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.asarray(generate(params, p2, cfg, max_new_tokens=args.new))
+    tN = time.perf_counter() - t0
+    steady = (args.new - 1) * args.batch / max(tN - t1, 1e-9)
+    row = {
+        "model": args.model, "platform": jax.devices()[0].platform,
+        "batch": args.batch, "prompt_len": args.prompt,
+        "new_tokens": args.new,
+        "prefill_plus_1_s": round(t1, 3),
+        "total_s": round(tN, 3),
+        "steady_decode_tokens_per_sec": round(steady, 1),
+        "steady_ms_per_token_per_seq": round(
+            (tN - t1) / (args.new - 1) * 1e3, 2),
+    }
+    print(f"[decode] {row}")
+    out_dir = Path(args.out_dir)
+    out_dir.mkdir(exist_ok=True)
+    path = out_dir / f"decode_{jax.devices()[0].platform}.json"
+    path.write_text(json.dumps(row, indent=1))
+    print(f"[decode] wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
