@@ -90,7 +90,7 @@ KNOB_MATRIX = [
                                      "matmul_precision": "int8_bwd"},
      {"reshard_after_forward": True}, 2),
     ("auto_int8", {"matmul_precision": "int8_bwd"}, None, 1),
-    # batch scaling saturates: b8 measured 125.56 vs b4's 125.12 TFLOPS
+    # batch scaling saturates: b8 measured 125.78 vs b4's 125.12 TFLOPS
     # (r3) — the knob-space ceiling is compute-bound, not batch-bound.
     ("explicit_int8_bwd_b4x", {"matmul_precision": "int8_bwd"},
      {"reshard_after_forward": True}, 4),

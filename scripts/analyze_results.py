@@ -128,6 +128,20 @@ def longctx_table(rows: list[dict]) -> str:
     return "\n".join(out)
 
 
+def decode_table(rows: list[dict]) -> str:
+    if not rows:
+        return "_no decode benchmark found_\n"
+    out = ["| model | platform | batch | prompt | new | steady tok/s | "
+           "ms/token/seq |", "|---|---|---|---|---|---|---|"]
+    for r in rows:
+        out.append(f"| {r['model']} | {r['platform']} | {r['batch']} | "
+                   f"{r['prompt_len']} | {r['new_tokens']} | "
+                   f"{r.get('steady_decode_tokens_per_sec', '—')} | "
+                   f"{r.get('steady_ms_per_token_per_seq', '—')} |")
+    out.append("")
+    return "\n".join(out)
+
+
 def moe_drop_note(dirname: str) -> str:
     """Grouped-dispatch drop rates from the bench artifact (written by
     ``moe_bench.measure_drop_rates`` next to the rows it describes)."""
@@ -368,6 +382,7 @@ def main(argv=None):
     p.add_argument("--pp-dir", default="pp_results")
     p.add_argument("--longctx-dir", default="longcontext_results")
     p.add_argument("--moe-dir", default="moe_results")
+    p.add_argument("--decode-dir", default="decode_results")
     p.add_argument("--out", default="RESULTS.md")
     p.add_argument("--plots", action="store_true",
                    help="additionally render PNG charts under plots/")
@@ -412,6 +427,9 @@ def main(argv=None):
         "FLOPs." + moe_drop_note(args.moe_dir),
         "",
         moe_table(moe),
+        "## Autoregressive decode (`scripts/decode_bench.py`)",
+        "",
+        decode_table(_load_json_rows(args.decode_dir)),
     ]
     if args.plots:
         pngs = write_plots(prec, longctx, moe)

@@ -27,6 +27,10 @@ def main(argv=None):
     p.add_argument("--experts", type=int, default=8)
     p.add_argument("--moe-ffn", type=int, default=0,
                    help="per-expert ffn width (default intermediate/4)")
+    p.add_argument("--top-k", type=int, default=1,
+                   help="experts per token (1 = Switch, 2 = GShard "
+                        "top-2 with normalized gates)")
+    p.add_argument("--capacity-factor", type=float, default=2.0)
     args, rest = p.parse_known_args(argv)
 
     if args.cpu_devices:
@@ -60,7 +64,8 @@ def main(argv=None):
     base: T.TransformerConfig = getattr(T, MODELS[args.model])
     mcfg = dataclasses.replace(
         base, n_experts=args.experts,
-        moe_ffn=args.moe_ffn or max(base.intermediate_size // 4, 8))
+        moe_ffn=args.moe_ffn or max(base.intermediate_size // 4, 8),
+        moe_top_k=args.top_k, moe_capacity_factor=args.capacity_factor)
     # consume the shared --precision knob (int8 variants quantize the
     # attention projections AND the per-expert MLP matmuls)
     if cfg.precision.startswith("int8"):
