@@ -1,6 +1,7 @@
 from .packing import (  # noqa: F401
     pack_tokens, packed_batches, synthetic_token_stream,
-    get_tinystories_tokens, make_packed_dataset, VocabMismatchError)
+    get_tinystories_tokens, get_corpus_tokens, tokenize_documents,
+    read_corpus_documents, make_packed_dataset, VocabMismatchError)
 from .classification import (  # noqa: F401
     classification_batches, make_classification_examples, pad_collate,
     shard_examples, synthetic_pair_examples)

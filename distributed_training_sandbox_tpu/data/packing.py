@@ -55,6 +55,25 @@ def synthetic_token_stream(num_tokens: int, vocab_size: int,
     return rng.choice(vocab_size, size=num_tokens, p=probs).astype(np.int32)
 
 
+def tokenize_documents(docs, tok, max_docs: int | None = None) -> np.ndarray:
+    """The real-tokenizer core shared by every text source: tokenize each
+    document, append EOS, concatenate into one int32 stream — exactly the
+    reference's per-doc loop (``fsdp/utils.py:47-57``).  ``docs`` yields
+    strings; ``tok`` is any HF tokenizer with ``__call__`` and
+    ``eos_token_id``."""
+    chunks = []
+    for i, doc in enumerate(docs):
+        if max_docs is not None and i >= max_docs:
+            break
+        ids = list(tok(doc)["input_ids"])
+        if tok.eos_token_id is not None:
+            ids.append(tok.eos_token_id)
+        chunks.append(np.asarray(ids, dtype=np.int32))
+    if not chunks:
+        raise ValueError("no documents to tokenize")
+    return np.concatenate(chunks)
+
+
 def get_tinystories_tokens(tokenizer_name: str = "HuggingFaceTB/SmolLM3-3B",
                            split_percent: int = 5,
                            max_docs: int | None = None) -> np.ndarray:
@@ -62,21 +81,49 @@ def get_tinystories_tokens(tokenizer_name: str = "HuggingFaceTB/SmolLM3-3B",
     ``fsdp/utils.py:29-57``; ``split_percent`` 5 = fsdp flavor, 10 = fp8
     flavor).  Requires network + ``datasets``/``transformers``; callers on
     air-gapped hosts should catch and fall back to
-    ``synthetic_token_stream``."""
+    ``synthetic_token_stream`` — or point ``get_corpus_tokens`` at a local
+    text corpus to keep the real-tokenizer path without the network."""
     from datasets import load_dataset  # gated import
     from transformers import AutoTokenizer
 
     ds = load_dataset("roneneldan/TinyStories",
                       split=f"train[:{split_percent}%]")
     tok = AutoTokenizer.from_pretrained(tokenizer_name)
-    chunks = []
-    for i, doc in enumerate(ds):
-        if max_docs is not None and i >= max_docs:
-            break
-        ids = tok(doc["text"])["input_ids"]
-        ids.append(tok.eos_token_id)
-        chunks.append(np.asarray(ids, dtype=np.int32))
-    return np.concatenate(chunks)
+    return tokenize_documents((doc["text"] for doc in ds), tok, max_docs)
+
+
+def read_corpus_documents(corpus_path) -> list[str]:
+    """A local text file as a document list: blank-line-separated blocks,
+    each block one document (the fixture-corpus convention,
+    ``tests/fixtures/tiny_corpus.txt``)."""
+    from pathlib import Path
+    text = Path(corpus_path).read_text()
+    docs = [blk.strip() for blk in text.split("\n\n") if blk.strip()]
+    if not docs:
+        raise ValueError(f"no documents in {corpus_path}")
+    return docs
+
+
+def get_corpus_tokens(corpus_path, *,
+                      tokenizer_file=None,
+                      tokenizer_name: str | None = None,
+                      max_docs: int | None = None) -> np.ndarray:
+    """The offline real-tokenizer branch: tokenize a LOCAL corpus through
+    a genuine HF tokenizer — same per-doc tokenize→EOS→concat core as the
+    TinyStories path, no network.  ``tokenizer_file`` loads a committed
+    ``tokenizer.json`` (``transformers.PreTrainedTokenizerFast``);
+    ``tokenizer_name`` falls back to ``AutoTokenizer`` (cached/hub)."""
+    if tokenizer_file is not None:
+        from transformers import PreTrainedTokenizerFast
+        tok = PreTrainedTokenizerFast(tokenizer_file=str(tokenizer_file),
+                                      eos_token="<eos>", unk_token="<unk>")
+    elif tokenizer_name is not None:
+        from transformers import AutoTokenizer
+        tok = AutoTokenizer.from_pretrained(tokenizer_name)
+    else:
+        raise ValueError("need tokenizer_file or tokenizer_name")
+    return tokenize_documents(read_corpus_documents(corpus_path), tok,
+                              max_docs)
 
 
 def _hub_reachable(timeout: float = 2.0) -> bool:
@@ -102,11 +149,16 @@ def make_packed_dataset(seq_len: int, vocab_size: int, *,
                         split_percent: int = 5,
                         seed: int = 42,
                         source: str = "auto",
-                        engine: str = "numpy"):
+                        engine: str = "numpy",
+                        corpus_path=None,
+                        tokenizer_file=None,
+                        tokenizer_name: str | None = None):
     """One-call dataset: (input_ids, labels) arrays.
 
-    source: "tinystories" (requires network), "synthetic", or "auto"
-    (tinystories with synthetic fallback — the zero-egress default).
+    source: "tinystories" (requires network), "synthetic", "corpus"
+    (local text file through a real tokenizer — needs ``corpus_path`` and
+    ``tokenizer_file``/``tokenizer_name``), or "auto" (tinystories with
+    synthetic fallback — the zero-egress default).
 
     engine: "numpy" (default — the committed benchmarks' deterministic
     stream) or "native" (the C++ engine, ``data/native.py``: same Zipf
@@ -114,9 +166,9 @@ def make_packed_dataset(seq_len: int, vocab_size: int, *,
     ``data_results/native_data_bench.json`` — and its OWN seeded
     stream — pick per run, not per step).
     """
-    if source not in ("tinystories", "synthetic", "auto"):
+    if source not in ("tinystories", "synthetic", "auto", "corpus"):
         raise ValueError(f"unknown source {source!r}; expected 'tinystories',"
-                         f" 'synthetic' or 'auto'")
+                         f" 'synthetic', 'corpus' or 'auto'")
     if engine not in ("numpy", "native"):
         raise ValueError(f"unknown engine {engine!r}")
     if engine == "native":
@@ -128,6 +180,16 @@ def make_packed_dataset(seq_len: int, vocab_size: int, *,
         sample, pack = native.synthetic_token_stream, native.pack_tokens
     else:
         sample, pack = synthetic_token_stream, pack_tokens
+    if source == "corpus":
+        if corpus_path is None:
+            raise ValueError("source='corpus' needs corpus_path")
+        stream = get_corpus_tokens(corpus_path, tokenizer_file=tokenizer_file,
+                                   tokenizer_name=tokenizer_name)
+        if stream.max() >= vocab_size:
+            raise VocabMismatchError(
+                f"corpus token ids go up to {stream.max()}, model vocab is "
+                f"{vocab_size}; use a matching tokenizer")
+        return pack(stream, seq_len)
     if source in ("tinystories", "auto"):
         try:
             if source == "auto" and not _hub_reachable():

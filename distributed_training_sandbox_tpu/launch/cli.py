@@ -40,6 +40,8 @@ def _build_config(args) -> LaunchConfig:
         cfg.trace_output_dir = args.dest
     if getattr(args, "devices", None):
         cfg.device_spec = args.devices
+    if getattr(args, "nprocs", None):
+        cfg.nprocs = args.nprocs
     return cfg
 
 
@@ -58,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--num-epochs", type=int, default=None)
         sp.add_argument("--devices", type=str, default=None,
                         help='device spec: "tpu" (default) or "cpu:8"')
+        sp.add_argument("--nprocs", type=int, default=None,
+                        help="worker processes (torchrun --nproc_per_node"
+                             " twin); needs a cpu:<k> device spec")
         sp.add_argument("--dry-run", action="store_true",
                         help="print the command + trace dir, don't execute")
         sp.add_argument("extra", nargs=argparse.REMAINDER,

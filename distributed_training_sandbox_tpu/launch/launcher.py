@@ -8,7 +8,14 @@ substrate changes:
   * the reference builds a cloud container and execs ``torchrun
     --nproc_per_node=N`` inside it; here the "cluster" is a jax device mesh —
     real TPU chips, or ``--cpu-devices N`` simulated devices (the gloo-mode
-    twin) — so launching is spawning ONE Python process per host, not N.
+    twin) — so the SPMD default is ONE Python process per host.  The
+    torchrun contract itself is ``nprocs > 1``: the launcher stands up a
+    local coordinator (``DTS_COORDINATOR``/``DTS_NUM_PROCESSES``/
+    ``DTS_PROCESS_ID`` env, consumed by
+    ``utils.mesh.auto_initialize_from_env``) and spawns N workers whose
+    simulated devices join ONE global mesh — the
+    ``torchrun --standalone --nproc_per_node=N`` twin
+    (``modal_utils.py:115-119``).
   * the GPU spec string ``"A10G:2"`` (``modal_utils.get_gpu_count``,
     ``modal_utils.py:60-72``) becomes a device spec ``"tpu"`` / ``"tpu:4"`` /
     ``"cpu:8"``: platform[:count].
@@ -96,6 +103,11 @@ class LaunchConfig:
     script_dir: str | os.PathLike = _REPO_ROOT / "scripts"
     script: str = "fsdp"
     device_spec: str = "tpu"
+    #: worker processes per host — the ``torchrun --nproc_per_node=N``
+    #: twin (``modal_utils.py:115-119``).  1 = the SPMD default (one
+    #: process per host); N > 1 spawns a coordinator env (DTS_* vars) and
+    #: N workers whose simulated devices form ONE global mesh.
+    nprocs: int = 1
     timeout: float | None = 1800.0          # zero/modal_app.py:12
     trace_root: str | os.PathLike = "./profiler_traces"
     trace_output_dir: str | os.PathLike = "./traces"   # sync destination
@@ -126,6 +138,8 @@ class LaunchConfig:
             kw["script"] = app["training_script"]
         if "spec" in devices:
             kw["device_spec"] = devices["spec"]
+        if "nprocs" in devices:
+            kw["nprocs"] = int(devices["nprocs"])
         if "timeout" in devices:
             kw["timeout"] = devices["timeout"]
         if "root" in trace:
@@ -224,18 +238,94 @@ def run_training(config: LaunchConfig, *, script: str | None = None,
     env["TRACE_DIR"] = str(trace_dir)
     env.update({k: str(v) for k, v in config.env.items()})
 
-    print(f"[launch] {config.name}: {' '.join(cmd)}")
+    nprocs = int(config.nprocs or 1)
+    print(f"[launch] {config.name}: {' '.join(cmd)}"
+          + (f" (x{nprocs} processes)" if nprocs > 1 else ""))
     print(f"[launch] TRACE_DIR={trace_dir}")
     if dry_run:
         return RunResult(run_id, trace_dir, cmd, 0)
     trace_dir.mkdir(parents=True, exist_ok=True)
-    proc = subprocess.run(cmd, env=env, timeout=config.timeout)
-    if proc.returncode == 0:
+    if nprocs > 1:
+        returncode = _run_multiprocess(config, cmd, env, trace_dir, nprocs)
+    else:
+        returncode = subprocess.run(cmd, env=env,
+                                    timeout=config.timeout).returncode
+    if returncode == 0:
         print_completion_message(config, run_id, script or config.script)
     else:
-        print(f"[launch] FAILED (exit {proc.returncode}): {' '.join(cmd)}",
+        print(f"[launch] FAILED (exit {returncode}): {' '.join(cmd)}",
               file=sys.stderr)
-    return RunResult(run_id, trace_dir, cmd, proc.returncode)
+    return RunResult(run_id, trace_dir, cmd, returncode)
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_multiprocess(config: LaunchConfig, cmd: list[str], env: dict,
+                      trace_dir: Path, nprocs: int) -> int:
+    """The torchrun contract: coordinator address + N worker processes,
+    each joining one global mesh via the DTS_* env consumed in
+    ``utils.mesh.auto_initialize_from_env``.  Requires a ``cpu:K`` device
+    spec (K simulated devices per process → an N·K-device mesh); real
+    multi-host TPU launches use one process per host with JAX's own
+    topology discovery instead.
+
+    Worker stdout/stderr stream to ``<trace_dir>/worker_<i>.log``;
+    worker 0's log is echoed on completion (the rank-0-prints-the-report
+    convention of every strategy script)."""
+    platform, _ = parse_device_spec(config.device_spec)
+    if platform != "cpu":
+        raise ValueError(
+            f"nprocs={nprocs} needs a 'cpu:<k>' device spec (got "
+            f"{config.device_spec!r}) — multi-process TPU uses one "
+            f"process per host with auto topology discovery")
+    coord = f"127.0.0.1:{_free_port()}"
+    base_env = {k: v for k, v in env.items()
+                if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                             "JAX_NUM_PROCESSES")}
+    procs, logs = [], []
+    for pid in range(nprocs):
+        wenv = {**base_env, "DTS_COORDINATOR": coord,
+                "DTS_NUM_PROCESSES": str(nprocs),
+                "DTS_PROCESS_ID": str(pid)}
+        log = (trace_dir / f"worker_{pid}.log").open("w")
+        logs.append(log)
+        procs.append(subprocess.Popen(cmd, env=wenv, stdout=log,
+                                      stderr=subprocess.STDOUT))
+    import time as _time
+    deadline = (_time.monotonic() + config.timeout
+                if config.timeout else None)
+    rc = 0
+    try:
+        for pid, p in enumerate(procs):
+            remaining = (max(deadline - _time.monotonic(), 0.1)
+                         if deadline else None)
+            code = p.wait(timeout=remaining)
+            # signal-killed workers return NEGATIVE codes — any nonzero
+            # (either sign) must fail the run, so don't max() with 0
+            if code != 0 and rc == 0:
+                rc = 1
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:   # reap, don't leave zombies
+            p.wait()
+        raise
+    finally:
+        for log in logs:
+            log.close()
+    w0 = trace_dir / "worker_0.log"
+    if w0.exists():
+        sys.stdout.write(w0.read_text())
+    for pid, p in enumerate(procs):
+        if p.returncode:
+            print(f"[launch] worker {pid} exit {p.returncode} — see "
+                  f"{trace_dir / f'worker_{pid}.log'}", file=sys.stderr)
+    return rc
 
 
 def sync_traces(config: LaunchConfig, run_id: str | None = None,

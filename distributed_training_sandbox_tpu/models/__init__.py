@@ -3,7 +3,8 @@ from .transformer import (  # noqa: F401
     TransformerConfig, SMOLLM3_3B, SMOLLM3_3B_L8, SMOLLM3_350M, TINY_LM,
     QWEN3_4B, QWEN3_4B_L6, LLAMA32_1B, LLAMA31_8B,
     init_params, forward, lm_loss, model_flops_per_token)
-from .generate import generate, init_cache, KVCache  # noqa: F401
+from .generate import (  # noqa: F401
+    generate, init_cache, KVCache, quantize_decode_params)
 from .classifier import (  # noqa: F401
     init_classifier_params, classifier_logits, classification_loss,
     classification_accuracy)

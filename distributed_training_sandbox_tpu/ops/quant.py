@@ -30,7 +30,7 @@ pieces, mirroring torchao's roles:
 from __future__ import annotations
 
 import functools
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +47,38 @@ def quantize_int8(x: jax.Array, axis: int = -1):
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127)
     return q.astype(jnp.int8), scale
+
+
+class QuantizedWeight(NamedTuple):
+    """A weight stored AS int8 in HBM (plus its dequant scales) — for
+    weight-STATIC uses (decode: weights never change across the whole
+    generate call), where the win is not MXU rate but HBM bandwidth:
+    every decode step reads every weight byte, so int8 storage halves the
+    weight-read-bound step time.  Quantize once (``quantize_weight``),
+    then any ``resolve_quantized_dense`` matmul accepts it in place of
+    the bf16 array.  ``q``: int8 with the contraction dim where the bf16
+    weight had it; ``s``: f32 scales, contraction dim kept at size 1."""
+    q: jax.Array
+    s: jax.Array
+
+
+def quantize_weight(w: jax.Array, *, contract_axis: int = -2) -> QuantizedWeight:
+    """(…, K, N) bf16 → QuantizedWeight: per-output-column absmax over the
+    contraction dim (default: second-minor, the (K, N) layout of every
+    projection here; stacked (L, K, N) leaves quantize per layer)."""
+    q, s = quantize_int8(w, axis=contract_axis)
+    return QuantizedWeight(q=q, s=s)
+
+
+def prequantized_dense(a: jax.Array, w: QuantizedWeight) -> jax.Array:
+    """(…, K) · QuantizedWeight(K, N) → (…, N): dynamic per-row activation
+    quantize + int8 MXU dot.  The weight arrives int8 from HBM — half the
+    bytes of bf16, the decode-bandwidth play."""
+    lead = a.shape[:-1]
+    a2 = a.reshape(-1, a.shape[-1])
+    xq, xs = quantize_int8(a2, axis=-1)
+    out = int8_matmul(xq, xs, w.q, w.s.reshape(1, -1), out_dtype=a.dtype)
+    return out.reshape(*lead, w.q.shape[-1])
 
 
 def dequantize(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16):
@@ -195,15 +227,24 @@ def resolve_quantized_dense(precision: str):
     mapping shared by the attention projections (``transformer._dense``)
     and the per-expert MoE matmuls (``parallel.expert.moe_mlp``), so the
     same precision string always selects the same impl everywhere.
-    ``"bf16"`` returns a plain matmul."""
+    ``"bf16"`` returns a plain matmul.
+
+    Every returned matmul also accepts a ``QuantizedWeight`` in the weight
+    slot (decode's weight-static int8 storage) and routes it through
+    ``prequantized_dense`` — so the decode path can hand pre-quantized
+    layer pytrees to the SAME shared projection helpers the training
+    model uses."""
     if precision == "bf16":
-        return lambda a, w: a @ w
-    base = precision.removesuffix("_bwd")
-    impl = {"int8": "xla", "int8_pallas": "pallas_fused"}[base]
-    quantize_bwd = precision.endswith("_bwd")
-    interpret = jax.default_backend() != "tpu"
-    return lambda a, w: quantized_dense(a, w, impl, interpret,
-                                        quantize_bwd)
+        base_fn = lambda a, w: a @ w  # noqa: E731
+    else:
+        base = precision.removesuffix("_bwd")
+        impl = {"int8": "xla", "int8_pallas": "pallas_fused"}[base]
+        quantize_bwd = precision.endswith("_bwd")
+        interpret = jax.default_backend() != "tpu"
+        base_fn = lambda a, w: quantized_dense(  # noqa: E731
+            a, w, impl, interpret, quantize_bwd)
+    return lambda a, w: (prequantized_dense(a, w)
+                         if isinstance(w, QuantizedWeight) else base_fn(a, w))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

@@ -142,6 +142,7 @@ def make_fsdp_train_step(
     quantized_gather: bool = False,
     sp_axis: str | None = None,
     lr: float = 3e-4,
+    lr_schedule: Callable | None = None,
     b1: float = 0.9,
     b2: float = 0.95,
     eps: float = 1e-8,
@@ -160,6 +161,10 @@ def make_fsdp_train_step(
     the batch's sequence dim shards over that mesh axis, attention runs
     as the ring (``ops/ring_attention.py``), and the sp-replicated param
     grads get an explicit mean-psum across the ring.
+
+    ``lr_schedule``: optional ``count -> lr`` (e.g.
+    ``optim.warmup_cosine_schedule``) evaluated on the optimizer step
+    counter inside the jitted step; overrides the constant ``lr``.
     """
     ws = int(mesh.shape[axis])
     if sp_axis is not None:
@@ -219,9 +224,10 @@ def make_fsdp_train_step(
                 if sp_axis is not None else (lambda g: g / ws),
                 grad_shards)
         with scope("opt_step"):
+            lr_t = lr_schedule(opt_state.count) if lr_schedule else lr
             shards, opt_state = optim.adam_update(
                 grad_shards, opt_state, shards,
-                lr=lr, b1=b1, b2=b2, eps=eps)
+                lr=lr_t, b1=b1, b2=b2, eps=eps)
         return shards, opt_state, loss
 
     state_specs = optim.AdamState(mu=specs, nu=specs, count=P())

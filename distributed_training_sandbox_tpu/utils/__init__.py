@@ -4,6 +4,9 @@ from .mesh import (  # noqa: F401
     get_mesh,
     register_mesh,
     setup_distributed,
+    auto_initialize_from_env,
+    host_to_global,
+    local_scalar,
     use_cpu_devices,
 )
 from .prng import set_seed, key_for_axis  # noqa: F401
@@ -13,6 +16,7 @@ from .memory import (  # noqa: F401
     device_memory_stats,
     print_memory_stats,
     peak_memory_gb,
+    classify_failure,
 )
 from .tracker import PerformanceTracker  # noqa: F401
 from .flops import get_model_flops_per_token  # noqa: F401

@@ -34,7 +34,8 @@ class FlopsConfig:
 
 
 def get_model_flops_per_token(cfg, seq_len: int, *, backward_factor: float = 2.0,
-                              causal: bool = True) -> float:
+                              causal: bool = True,
+                              include_lm_head: bool = True) -> float:
     """Forward+backward FLOPs per token.
 
     Matmul FLOPs count 2·m·n·k; the backward pass re-does each matmul twice
@@ -42,6 +43,12 @@ def get_model_flops_per_token(cfg, seq_len: int, *, backward_factor: float = 2.0
     multiplier — the same convention the reference's analytic model uses.
     ``cfg`` is any object with the FlopsConfig attribute names (an HF-style
     config works unchanged).
+
+    ``include_lm_head=False`` drops the per-token vocab-projection term —
+    the honest count for heads that are NOT a per-token unembedding (e.g.
+    the pooled classifier, whose head is one (B,H)@(H,2) matmul; counting
+    2·h·vocab per token there overstates TFLOPS/MFU by ~10-15% at
+    SmolLM3-350M geometry).
     """
     h = cfg.hidden_size
     inter = cfg.intermediate_size
@@ -69,6 +76,6 @@ def get_model_flops_per_token(cfg, seq_len: int, *, backward_factor: float = 2.0
     mlp = (3 if getattr(cfg, "gated_mlp", True) else 2) * 2 * h * inter \
         * active_k
     per_layer = q_proj + kv_proj + o_proj + attn_quadratic + mlp + router
-    head = 2 * h * vocab
+    head = 2 * h * vocab if include_lm_head else 0
     fwd = layers * per_layer + head
     return fwd * (1.0 + backward_factor)

@@ -19,6 +19,20 @@ MB = 1024**2
 GB = 1024**3
 
 
+def classify_failure(e: Exception) -> tuple[str, str]:
+    """(kind, message) for a benchmark-sweep failure: kind is ``"oom"``
+    only when XLA's own verdict says so — anything else is a real error
+    and must not be published as the memory edge (a transient compile
+    bug would otherwise masquerade as the OOM wall).  The ONE place the
+    OOM pattern lives, shared by every sweep script."""
+    import re
+    msg = str(e)
+    m = re.search(r"(Ran out of memory|RESOURCE_EXHAUSTED)[^\n]*", msg)
+    if m:
+        return "oom", m.group(0)[:200]
+    return "error", f"{type(e).__name__}: {msg[:200]}"
+
+
 def tree_size_mb(tree: Any) -> float:
     """Total size of all array leaves, in MB (tensor-walk twin of
     ``memory.py:8-34``)."""

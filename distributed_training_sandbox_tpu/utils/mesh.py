@@ -34,6 +34,13 @@ def use_cpu_devices(n: int = 8) -> None:
     The CI/test substrate (SURVEY.md §7.1): the twin of the reference running
     gloo on 2 CPU ranks.  Must run before the JAX backend initializes.  When a
     backend is already live this is a no-op if the platform is already cpu.
+
+    If the multi-process launcher's env contract is present
+    (``DTS_COORDINATOR``/``DTS_NUM_PROCESSES``/``DTS_PROCESS_ID`` — the
+    ``torchrun --nproc_per_node`` twin, set by ``dts-launch run
+    --nprocs N``), the process also joins the distributed cluster here,
+    so every strategy script's existing ``--cpu-devices`` bootstrap
+    becomes multi-process-capable with no per-script changes.
     """
     flags = os.environ.get("XLA_FLAGS", "")
     m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
@@ -45,6 +52,29 @@ def use_cpu_devices(n: int = 8) -> None:
         os.environ["XLA_FLAGS"] = flags.replace(
             m.group(0), f"--xla_force_host_platform_device_count={n}")
     jax.config.update("jax_platforms", "cpu")
+    auto_initialize_from_env()
+
+
+_DTS_INITIALIZED = False
+
+
+def auto_initialize_from_env() -> bool:
+    """Join the launcher-spawned process group when the ``DTS_*`` env
+    contract is set (no-op otherwise; returns whether it initialized).
+    Guarded by a module flag, NOT ``jax.process_count()`` — querying the
+    backend would initialize it single-process and lock distributed
+    bring-up out."""
+    global _DTS_INITIALIZED
+    coord = os.environ.get("DTS_COORDINATOR")
+    nprocs = os.environ.get("DTS_NUM_PROCESSES")
+    if not coord or not nprocs or int(nprocs) < 2:
+        return False
+    if _DTS_INITIALIZED:
+        return True
+    setup_distributed(coord, num_processes=int(nprocs),
+                      process_id=int(os.environ["DTS_PROCESS_ID"]))
+    _DTS_INITIALIZED = True
+    return True
 
 
 def setup_distributed(
@@ -153,6 +183,28 @@ def get(what: str, mesh_name: str = DEFAULT_MESH):
         axis = what.split(":", 1)[1]
         return int(get_mesh(mesh_name).shape[axis])
     raise KeyError(f"unknown runtime key {what!r}")
+
+
+def host_to_global(arr, mesh: Mesh, spec: PartitionSpec) -> jax.Array:
+    """A host-identical value (same on every process, e.g. identically
+    seeded) → one GLOBAL array sharded by ``spec`` over ``mesh``.
+    Single-process this is just ``device_put``; multi-process it builds
+    the global array from per-process local shards — what jit requires
+    when the mesh spans processes (the torchrun-mode data path)."""
+    arr = np.asarray(arr)
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
+def local_scalar(x) -> float:
+    """float() of a (replicated) result that works whether or not the
+    array is fully addressable from this process."""
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        return float(np.asarray(x.addressable_data(0)))
+    return float(x)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -25,6 +25,7 @@ def _time_steps(step_fn, state, batch, n_steps, profiler=None, label=""):
     """Run n_steps (first is untimed warmup/compile, like the reference's
     explicit warmup step, zero1.py:118-125). Returns (state, losses, sec/step)."""
     import jax
+    from distributed_training_sandbox_tpu.utils import local_scalar
     params, opt = state
     losses = []
     t0 = None
@@ -34,7 +35,7 @@ def _time_steps(step_fn, state, batch, n_steps, profiler=None, label=""):
         if i == 0:
             t0 = time.perf_counter()  # discard compile step
         else:
-            losses.append(float(loss))
+            losses.append(local_scalar(loss))
         if profiler:
             profiler.step()
     dt = (time.perf_counter() - t0) / max(n_steps - 1, 1)
@@ -81,8 +82,16 @@ def run_zero_ab(stage: int, argv=None):
     params = zero_toy_mlp(key, scale=args.scale)
     kx, ky = jax.random.split(key)
     width = 10_000 // args.scale
-    batch = (jax.random.normal(kx, (cfg.batch_size, width)),
-             jax.random.normal(ky, (cfg.batch_size, width)))
+    # host_to_global: identically-seeded host values -> global replicated
+    # arrays, valid whether the mesh lives in one process or spans the
+    # launcher's N workers (the torchrun-contract data path).
+    from distributed_training_sandbox_tpu.utils import host_to_global
+    from jax.sharding import PartitionSpec as P
+    batch = tuple(
+        host_to_global(a, mesh, P())
+        for a in (jax.random.normal(kx, (cfg.batch_size, width)),
+                  jax.random.normal(ky, (cfg.batch_size, width))))
+    params = jax.tree.map(lambda a: host_to_global(a, mesh, P()), params)
 
     # fresh Profiler per leg: a repeat=1 schedule is consumed by the first
     # leg's steps, so sharing one would leave the sharded leg untraced

@@ -79,7 +79,10 @@ def run_one(bs: int, seq: int, mcfg, mesh, num_steps: int) -> dict:
     dt = (time.perf_counter() - t0) / num_steps
 
     ws = int(mesh.devices.size)
-    ft = get_model_flops_per_token(mcfg, seq)
+    # The benchmarked model is the CLASSIFIER: its head is one pooled
+    # (B,H)@(H,2) matmul, not a per-token vocab projection — drop the
+    # 2·h·vocab/token LM-head term or TFLOPS/MFU overstate by ~10-15%.
+    ft = get_model_flops_per_token(mcfg, seq, include_lm_head=False)
     tflops_dev = bs * seq * ft / dt / ws / 1e12
     peak = PEAK_BF16.get(jax.devices()[0].platform)
     return {
@@ -112,9 +115,20 @@ def main(argv=None):
         MODEL_REGISTRY, transformer as T)
     from distributed_training_sandbox_tpu.utils import make_mesh
 
+    from distributed_training_sandbox_tpu.utils import classify_failure
+
     mcfg = getattr(T, MODEL_REGISTRY[args.model])
     mesh = make_mesh()
     platform = jax.devices()[0].platform
+    out = Path(args.out_dir)
+    out.mkdir(exist_ok=True)
+    path = out / f"utilization_{platform}.json"
+
+    def persist(rows):
+        path.write_text(json.dumps(
+            {"model": args.model, "platform": platform, "rows": rows},
+            indent=1))
+
     rows = []
     bs_grid = [8, 32, 64, 128]      # the reference's grid...
     nxt = 256                       # ...then double to find the edge
@@ -128,18 +142,19 @@ def main(argv=None):
                 bs_grid.append(nxt)
                 nxt *= 2
         except Exception as e:   # noqa: BLE001 — the OOM edge IS the result
-            rows.append({"batch_size": bs, "seq": args.seq,
-                         "error": f"{type(e).__name__}: {str(e)[:200]}"})
-            print(f"[ddp-util] bs={bs}: {type(e).__name__} (edge found)",
-                  flush=True)
-            break
-
-    out = Path(args.out_dir)
-    out.mkdir(exist_ok=True)
-    path = out / f"utilization_{platform}.json"
-    path.write_text(json.dumps(
-        {"model": args.model, "platform": platform, "rows": rows},
-        indent=1))
+            kind, msg = classify_failure(e)
+            if kind == "oom":   # XLA's own verdict: this row IS the edge
+                rows.append({"batch_size": bs, "seq": args.seq,
+                             "error": f"OOM: {msg[:180]}"})
+                print(f"[ddp-util] bs={bs}: OOM (edge found)", flush=True)
+                break
+            # anything else is a real failure, not the edge — persist the
+            # measured rows, then re-raise so it can't be published as
+            # the OOM wall
+            persist(rows)
+            raise
+        persist(rows)
+    persist(rows)
     print(f"[ddp-util] wrote {path}")
 
     # append/replace our section in EXPERIMENTS.md

@@ -1,0 +1,45 @@
+"""Build the committed test-fixture tokenizer: a REAL byte-level-free BPE
+tokenizer trained offline on ``tests/fixtures/tiny_corpus.txt``, saved as
+``tests/fixtures/tokenizer.json``.
+
+This gives the test suite a genuine HF-fast tokenizer (loadable via
+``transformers.PreTrainedTokenizerFast(tokenizer_file=...)``) with zero
+network, so the real tokenize→pack branch of the data pipeline — the role
+of the reference's TinyStories+AutoTokenizer path
+(``fsdp/utils.py:29-57``) — is exercised end-to-end in CI.
+
+Vocab is 512 to match ``TINY_LM.vocab_size`` so the packed fixture stream
+feeds the CI model directly.
+
+    python scripts/make_fixture_tokenizer.py
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+VOCAB = 512
+
+
+def main() -> None:
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+    corpus = ROOT / "tests" / "fixtures" / "tiny_corpus.txt"
+    out = ROOT / "tests" / "fixtures" / "tokenizer.json"
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.BpeTrainer(
+        vocab_size=VOCAB, special_tokens=["<unk>", "<eos>"],
+        show_progress=False)
+    tok.train([str(corpus)], trainer)
+    tok.save(str(out))
+    n = tok.get_vocab_size()
+    print(f"[fixture-tokenizer] vocab {n} -> {out}")
+    if n > VOCAB:
+        sys.exit(f"vocab {n} exceeds target {VOCAB}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,260 @@
+"""MoE quality A/B: is the MoE throughput win real at matched wall-clock?
+
+r3 headlined MoE tok/s at capacity factor 1.0 — an operating point that
+drops ~9.7% of (token, assignment) pairs at init — with no quality
+evidence.  The reference's whole fp8 dir exists to make a *fair*
+throughput comparison (``fp8/fp8_benchmark.py:162-188``); this is the
+MoE equivalent:
+
+  * three legs — dense 3B-L8, MoE cf 2.0, MoE cf 1.0 (8 experts ×
+    ffn 2752 = dense MLP FLOPs split 4-ways active; grouped dispatch,
+    the timed headline path) — each trained for the SAME wall-clock
+    budget on the SAME seeded batch stream with the same warmup+cosine
+    schedule;
+  * every leg logs every step's train loss + wall time, and a fixed
+    held-out eval loss every ``--eval-every`` steps;
+  * MoE legs log the drop-rate trajectory as the router trains,
+    measured with the dispatch's OWN capacity rule
+    (``expert.grouped_drop_fraction`` on the live router's assignments —
+    the aux load-balance loss is what moves it);
+  * output: ``moe_results/quality_ab_<platform>.json`` + plots
+    (loss vs wall-clock, loss vs step, drop rate vs step).
+
+The verdict the json carries: eval loss at matched wall-clock, dense vs
+each capacity factor — the number the MoE throughput headline must be
+restated against.
+
+    python scripts/moe_quality_ab.py --seconds 420
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+BASE_MOE = {"n_experts": 8, "moe_ffn": 2752, "moe_dispatch": "grouped"}
+
+
+@contextlib.contextmanager
+def _mlp_drop_tap(T, expert_mod):
+    """Swap ``transformer._mlp_block``'s aux output for the grouped
+    dispatch's drop fraction while a metric function is being traced —
+    the routing and the capacity rule are the real ones
+    (``_route_topk`` + ``grouped_drop_fraction``), so this cannot drift
+    from what the timed train step enforces."""
+    orig = T._mlp_block
+
+    def with_drop(r, layer, *, cfg):
+        mlp, _lb = orig(r, layer, cfg=cfg)
+        B, S, H = r.shape
+        _, experts, _ = expert_mod._route_topk(
+            r.reshape(B * S, H), layer["w_router"], cfg.moe_top_k)
+        drop = expert_mod.grouped_drop_fraction(
+            experts, cfg.n_experts, cfg.moe_group_size,
+            cfg.moe_capacity_factor)
+        return mlp, drop
+
+    T._mlp_block = with_drop
+    try:
+        yield
+    finally:
+        T._mlp_block = orig
+
+
+def run_leg(name: str, cfg_overrides: dict, seconds: float, seq: int,
+            bs: int, peak_lr: float, warmup: int, eval_every: int,
+            data, eval_batch) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.parallel import expert as E
+    from distributed_training_sandbox_tpu.parallel import fsdp, optim
+    from distributed_training_sandbox_tpu.utils import make_mesh, set_seed
+
+    over = dict(cfg_overrides)
+    over.setdefault(
+        "attention_impl",
+        "flash" if jax.default_backend() == "tpu" else "xla")
+    mcfg = dataclasses.replace(T.SMOLLM3_3B_L8, **over)
+    mesh = make_mesh()
+    key = set_seed(42)
+    params = T.init_params(key, mcfg)
+    shards = fsdp.shard_params_fsdp(params, mesh)
+    del params
+    opt = fsdp.init_fsdp_opt_state(shards)
+    # long horizon: decay is effectively flat across legs; warmup matters
+    sched = optim.warmup_cosine_schedule(peak_lr, warmup, 100_000)
+    step = fsdp.make_fsdp_train_step(shards, mcfg, mesh, lr_schedule=sched)
+
+    eval_loss = jax.jit(lambda p, b: T.lm_loss(p, b, mcfg))
+    drop_fn = None
+    if mcfg.n_experts:
+        with _mlp_drop_tap(T, E):
+            drop_fn = jax.jit(
+                lambda p, ids: T.hidden_states(
+                    p, ids, mcfg, return_aux=True)[1]
+                / mcfg.num_hidden_layers)
+            ids_aval = jax.ShapeDtypeStruct((bs, seq), jnp.int32)
+            drop_fn = drop_fn.lower(shards, ids_aval).compile()
+
+    ii, ll = data
+    n = len(ii)
+    losses, times, evals, drops = [], [], [], []
+    i = 0
+    t0 = None
+    while True:
+        j = i % (n // bs)
+        batch = (jnp.asarray(ii[j * bs:(j + 1) * bs]),
+                 jnp.asarray(ll[j * bs:(j + 1) * bs]))
+        if drop_fn is not None and i % eval_every == 0:
+            drops.append((i, float(drop_fn(shards, batch[0]))))
+        shards, opt, loss = step(shards, opt, batch)
+        if i % eval_every == 0:
+            evals.append((i, float(eval_loss(shards, eval_batch)),
+                          0.0 if t0 is None else time.perf_counter() - t0))
+        losses.append(float(loss))
+        if t0 is None:
+            t0 = time.perf_counter()   # clock starts after compile step
+        times.append(time.perf_counter() - t0)
+        i += 1
+        if times[-1] > seconds:
+            break
+        if i % 25 == 0:
+            print(f"[moe-ab:{name}] step {i:4d} loss {losses[-1]:7.4f} "
+                  f"t {times[-1]:5.0f}s"
+                  + (f" drop {drops[-1][1]:.3f}" if drops else ""),
+                  flush=True)
+    final_eval = float(eval_loss(shards, eval_batch))
+    tok_s = (len(losses) - 1) * bs * seq / times[-1]
+    print(f"[moe-ab:{name}] done: {len(losses)} steps, "
+          f"{tok_s:.0f} tok/s, final eval {final_eval:.4f}", flush=True)
+    return {
+        "name": name,
+        "config": {k: (v if isinstance(v, (int, float, str, bool,
+                                           type(None))) else str(v))
+                   for k, v in cfg_overrides.items()},
+        "seq": seq, "batch": bs,
+        "seconds": times[-1], "steps": len(losses),
+        "tokens_per_second": round(tok_s, 1),
+        "final_eval_loss": final_eval,
+        "losses": losses, "times": times,
+        "evals": evals, "drop_trajectory": drops,
+    }
+
+
+def plot(out: dict, path: Path) -> None:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, (a1, a2, a3) = plt.subplots(1, 3, figsize=(15, 4))
+    for leg in out["legs"]:
+        a1.plot(leg["times"], leg["losses"], lw=0.7, label=leg["name"])
+        a2.plot([e[0] for e in leg["evals"]],
+                [e[1] for e in leg["evals"]], marker="o", ms=2,
+                label=leg["name"])
+        if leg["drop_trajectory"]:
+            a3.plot([d[0] for d in leg["drop_trajectory"]],
+                    [d[1] for d in leg["drop_trajectory"]], marker="o",
+                    ms=2, label=leg["name"])
+    a1.set_xlabel("wall-clock s (post-compile)")
+    a1.set_ylabel("train loss")
+    a1.set_title("loss vs wall-clock (matched budget)")
+    a2.set_xlabel("step"); a2.set_title("held-out eval loss")
+    a3.set_xlabel("step"); a3.set_ylabel("drop fraction")
+    a3.set_title("dispatch drop rate as router trains")
+    for a in (a1, a2, a3):
+        a.legend(fontsize=7)
+    fig.tight_layout()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(path, dpi=120)
+    print(f"[moe-ab] plot -> {path}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--seconds", type=float, default=420.0)
+    p.add_argument("--sequence-length", type=int, default=8192)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--peak-lr", type=float, default=3e-4)
+    p.add_argument("--warmup-steps", type=int, default=30)
+    p.add_argument("--eval-every", type=int, default=20)
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--tiny", action="store_true",
+                   help="CI shape: tiny geometry, short budget")
+    p.add_argument("--out-dir", default="moe_results")
+    p.add_argument("--plot", default="plots/moe_quality_ab.png")
+    args = p.parse_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    import jax
+    from distributed_training_sandbox_tpu.data import make_packed_dataset
+    from distributed_training_sandbox_tpu.models import transformer as T
+
+    seq, bs = args.sequence_length, args.batch_size
+    moe = dict(BASE_MOE)
+    tiny_over = {}
+    if args.tiny:
+        seq, bs = 128, 4
+        tiny_over = dataclasses.asdict(T.TINY_LM)
+        moe = {**BASE_MOE, "n_experts": 4, "moe_ffn": 40}
+
+    vocab = (tiny_over or dataclasses.asdict(T.SMOLLM3_3B_L8))["vocab_size"]
+    # ~400 steps of fresh windows, looped if a leg outruns them; +8 eval
+    n_tok = (400 * bs + 8) * (seq + 1)
+    ii, ll = make_packed_dataset(seq, vocab, num_tokens=n_tok,
+                                 source="synthetic", engine="native")
+    import jax.numpy as jnp
+    eval_batch = (jnp.asarray(ii[-8:]), jnp.asarray(ll[-8:]))
+    data = (ii[:-8], ll[:-8])
+
+    def with_tiny(over):
+        return {**tiny_over, **over} if args.tiny else over
+
+    legs = []
+    for name, over in [
+        ("dense", {}),
+        ("moe_cf2.0", {**moe, "moe_capacity_factor": 2.0}),
+        ("moe_cf1.0", {**moe, "moe_capacity_factor": 1.0}),
+    ]:
+        legs.append(run_leg(name, with_tiny(over), args.seconds, seq, bs,
+                            args.peak_lr, args.warmup_steps,
+                            args.eval_every, data, eval_batch))
+
+    dense_eval = legs[0]["final_eval_loss"]
+    out = {
+        "platform": jax.devices()[0].platform,
+        "seconds_budget": args.seconds,
+        "verdict": {
+            leg["name"]: {
+                "final_eval_loss": leg["final_eval_loss"],
+                "delta_vs_dense": round(leg["final_eval_loss"]
+                                        - dense_eval, 4),
+                "tokens_per_second": leg["tokens_per_second"],
+                "final_drop_rate": (leg["drop_trajectory"][-1][1]
+                                    if leg["drop_trajectory"] else None),
+            } for leg in legs
+        },
+        "legs": legs,
+    }
+    out_dir = Path(args.out_dir)
+    out_dir.mkdir(exist_ok=True)
+    path = out_dir / f"quality_ab_{out['platform']}.json"
+    path.write_text(json.dumps(out))
+    print(f"[moe-ab] verdict: {json.dumps(out['verdict'], indent=1)}")
+    print(f"[moe-ab] -> {path}")
+    plot(out, Path(args.plot))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,10 +9,17 @@ absmax scaling (``--precision int8``; ``int8_pallas`` routes the matmuls
 through the hand-tiled Pallas kernel).  ``--sweep`` reproduces the
 seq×precision grid of ``fp8/modal_app.py:90-110``.
 
+``--batch-sweep`` additionally crosses each (seq, precision) cell with
+batch ∈ {1, 2, 4, 8} (stopping the doubling at the first OOM and
+recording the edge, the reference's bs-128-OOM row discipline,
+``DDP/EXPERIMENTS.md:12``) so every family's headline is stated at its
+best *measured* batch rather than the batch-1 default.
+
 Usage:
   python scripts/precision_benchmark.py --model smollm3-350m \
       --precision int8 --sequence-length 4096 [--num-steps 20]
   python scripts/precision_benchmark.py --sweep [--model smollm3-350m]
+  python scripts/precision_benchmark.py --sweep --batch-sweep --model llama3.2-1b
 """
 
 from __future__ import annotations
@@ -29,11 +36,13 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from distributed_training_sandbox_tpu.models import MODEL_REGISTRY as MODELS  # noqa: E402
+from distributed_training_sandbox_tpu.utils import classify_failure  # noqa: E402
 
 SWEEP_SEQS = (2048, 4096, 8192)           # fp8/modal_app.py:90
 # {bf16, fp8} in the reference (fp8/modal_app.py:90-110); the v5e twin adds
 # the full-int8 recipe (backward matmuls quantized too) as the headline.
 SWEEP_PRECISIONS = ("bf16", "int8", "int8_bwd")
+SWEEP_BATCHES = (1, 2, 4, 8)
 
 
 def run_one(model: str, precision: str, seq_len: int, num_steps: int,
@@ -66,6 +75,17 @@ def run_one(model: str, precision: str, seq_len: int, num_steps: int,
                                  num_tokens=max(bs * 4, 8) * (seq_len + 1))
     batch = (jnp.asarray(ii[:bs]), jnp.asarray(ll[:bs]))
 
+    # Compile-time memory plan — the honest peak number on this substrate
+    # (the runtime allocator exposes no stats here; r2/r3 printed a dead
+    # device_peak_mb=0.0 from it).  Lowering first also turns an OOM into
+    # a compile-time verdict before any stepping; the compiled executable
+    # is then stepped directly (AOT compiles don't populate jit's
+    # dispatch cache — calling `step` again would compile twice).
+    step = step.lower(shards, opt, batch).compile()
+    ma = step.memory_analysis()
+    plan_gb = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
+               + ma.output_size_in_bytes) / 2**30
+
     flops_tok = get_model_flops_per_token(mcfg, seq_len)
     tracker = PerformanceTracker(warmup_steps=min(3, num_steps - 1),
                                  flops_per_token=flops_tok, num_devices=ws)
@@ -80,6 +100,8 @@ def run_one(model: str, precision: str, seq_len: int, num_steps: int,
     mem = print_memory_stats(f"{model}-{precision}-{seq_len}",
                              params=shards, opt_state=opt,
                              printer=log_lines.append)
+    log_lines.append(f"[memory-plan] {plan_gb:.2f} GB "
+                     "(compile-time: args+temps+outputs)")
 
     result = {
         "model": model,
@@ -92,13 +114,13 @@ def run_one(model: str, precision: str, seq_len: int, num_steps: int,
         "tflops_per_device": metrics.get("tflops_per_device", 0.0),
         "avg_loss": metrics.get("avg_loss"),
         "peak_memory": {
-            "device_peak_mb": mem["device_peak_mb"],
+            "memory_plan_gb": round(plan_gb, 2),
             "model_mb": mem["model_mb"],
             "optimizer_mb": mem["optimizer_mb"],
         },
     }
     out_dir.mkdir(parents=True, exist_ok=True)
-    tag = f"{model}_{precision}_seq{seq_len}_dev{ws}"
+    tag = f"{model}_{precision}_seq{seq_len}_b{bs}_dev{ws}"
     (out_dir / f"{tag}.txt").write_text("\n".join(log_lines) + "\n")
     print(f"[precision] {tag}: {result['tokens_per_second']:.0f} tok/s "
           f"{result['tflops_per_device']:.2f} TFLOPS/dev")
@@ -117,6 +139,9 @@ def main(argv=None):
     p.add_argument("--num-steps", type=int, default=12)
     p.add_argument("--sweep", action="store_true",
                    help="seq x precision grid (fp8/modal_app.py:90-110)")
+    p.add_argument("--batch-sweep", action="store_true",
+                   help="cross each cell with batch 1/2/4/8, stop "
+                        "doubling at the OOM edge and record it")
     p.add_argument("--out-dir", type=str, default="./precision_results")
     args = p.parse_args(argv)
 
@@ -131,18 +156,32 @@ def main(argv=None):
         default_seq = 256 if args.model == "tiny" else 4096
         grid = [(args.sequence_length or default_seq, args.precision)]
 
-    results = []
-    for seq, precision in grid:
-        try:
-            results.append(run_one(args.model, precision, seq,
-                                   args.num_steps, args.batch_size, out_dir))
-        except Exception as e:
-            print(f"[precision] {args.model}/{precision}/seq{seq} FAILED: "
-                  f"{type(e).__name__}: {str(e)[:160]}")
-
     stamp = time.strftime("%Y%m%d-%H%M%S")
     summary = out_dir / f"summary_{args.model}_{stamp}.json"
     out_dir.mkdir(parents=True, exist_ok=True)
+
+    results = []
+    for seq, precision in grid:
+        batches = (SWEEP_BATCHES if args.batch_sweep
+                   else (args.batch_size,))
+        for bs in batches:
+            try:
+                results.append(run_one(args.model, precision, seq,
+                                       args.num_steps, bs, out_dir))
+            except Exception as e:
+                kind, msg = classify_failure(e)
+                results.append({
+                    "model": args.model, "precision": precision,
+                    "sequence_length": seq, "batch_size": bs,
+                    "failure": kind, "error": msg})
+                print(f"[precision] {args.model}/{precision}/seq{seq}"
+                      f"/b{bs} {kind.upper()}: {msg[:120]}")
+                if kind == "oom":
+                    break       # the edge: bigger batches only OOM harder
+            # checkpoint the summary after every cell so a crash or an
+            # interrupt still leaves a usable artifact
+            summary.write_text(json.dumps(results, indent=2))
+
     summary.write_text(json.dumps(results, indent=2))
     print(f"[precision] summary -> {summary}")
     return results

@@ -1,0 +1,169 @@
+"""Flagship long-run training: the proof that the framework *trains*,
+not just that it is fast.
+
+The reference's artifacts carry loss (per-epoch losses in
+``pp/gpipe.py:205-218``); through r3 this repo's artifacts carried only
+throughput, with no committed loss series longer than 6 steps — and the
+6-step logs showed an unremarked step-2 spike (Adam's cold second moment
+taking a full-size first step).  This script:
+
+  * runs a ≥500-step run of the flagship config with LR warmup + cosine
+    decay (``optim.warmup_cosine_schedule``), logging EVERY step's loss;
+  * optionally runs a short no-warmup leg first to pin the spike the
+    warmup exists to kill (``--spike-demo``);
+  * writes ``flagship_results/<tag>.json`` (full loss series, lr series,
+    throughput) and a loss-curve plot to ``plots/flagship_loss.png``.
+
+Fresh (non-repeating) synthetic Zipfian batches: a learnable unigram
+skew with enough stream for every step to see new windows — the honest
+substrate for "does the loss go down" on an air-gapped host (real-text
+fixture training is covered by ``tests/test_data_fixture.py``).
+
+    python scripts/train_flagship.py --num-steps 500 --precision int8_bwd
+    python scripts/train_flagship.py --num-steps 500 --precision bf16
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_training_sandbox_tpu.models import MODEL_REGISTRY  # noqa: E402
+
+
+def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
+            warmup_steps: int, peak_lr: float, out_dir: Path,
+            tag_suffix: str = "") -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_training_sandbox_tpu.data import (
+        make_packed_dataset, packed_batches)
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.parallel import fsdp, optim
+    from distributed_training_sandbox_tpu.utils import make_mesh, set_seed
+
+    mcfg = getattr(T, MODEL_REGISTRY[model])
+    mcfg = dataclasses.replace(
+        mcfg, matmul_precision=precision,
+        attention_impl="flash" if jax.default_backend() == "tpu" else "xla")
+    mesh = make_mesh()
+    ws = int(mesh.devices.size)
+    key = set_seed(42)
+    params = T.init_params(key, mcfg)
+    shards = fsdp.shard_params_fsdp(params, mesh)
+    del params
+    opt = fsdp.init_fsdp_opt_state(shards)
+    sched = (optim.warmup_cosine_schedule(peak_lr, warmup_steps, num_steps)
+             if warmup_steps else None)
+    step = fsdp.make_fsdp_train_step(shards, mcfg, mesh, lr=peak_lr,
+                                     lr_schedule=sched)
+
+    # fresh windows for every step (engine="native": the C++ sampler, ~10x
+    # faster stream builds at this size)
+    n_tokens = num_steps * bs * (seq + 1) + seq + 1
+    ii, ll = make_packed_dataset(seq, mcfg.vocab_size, num_tokens=n_tokens,
+                                 source="synthetic", engine="native")
+
+    losses, lrs, times = [], [], []
+    t0 = time.perf_counter()
+    for i, (ib, lb) in enumerate(packed_batches(ii, ll, bs)):
+        if i >= num_steps:
+            break
+        shards, opt, loss = step(shards, opt,
+                                 (jnp.asarray(ib), jnp.asarray(lb)))
+        losses.append(float(loss))
+        lrs.append(float(sched(jnp.asarray(i)) if sched else peak_lr))
+        times.append(time.perf_counter() - t0)
+        if i % 25 == 0 or i == num_steps - 1:
+            print(f"[flagship] step {i:4d} loss {losses[-1]:8.4f} "
+                  f"lr {lrs[-1]:.2e} ({times[-1]:.0f}s)", flush=True)
+    dt = times[-1] - times[1] if len(times) > 2 else times[-1]
+    tok_s = (len(losses) - 1) * bs * seq / dt if dt > 0 else 0.0
+
+    warm = f"warm{warmup_steps}" if warmup_steps else "nowarm"
+    tag = f"{model}_{precision}_seq{seq}_b{bs}_{warm}{tag_suffix}"
+    result = {
+        "model": model, "precision": precision, "sequence_length": seq,
+        "batch_size": bs, "num_steps": len(losses),
+        "warmup_steps": warmup_steps, "peak_lr": peak_lr,
+        "devices": ws, "platform": jax.devices()[0].platform,
+        "tokens_per_second": round(tok_s, 1),
+        "loss_first": losses[0], "loss_max_first20": max(losses[:20]),
+        "loss_final_mean20": float(np.mean(losses[-20:])),
+        "losses": losses, "lrs": lrs,
+    }
+    out_dir.mkdir(parents=True, exist_ok=True)
+    (out_dir / f"{tag}.json").write_text(json.dumps(result))
+    print(f"[flagship] {tag}: first {losses[0]:.3f} "
+          f"max(first20) {result['loss_max_first20']:.3f} "
+          f"final(mean20) {result['loss_final_mean20']:.3f} "
+          f"{tok_s:.0f} tok/s -> {out_dir / (tag + '.json')}", flush=True)
+    return result
+
+
+def plot(out_dir: Path, plot_path: Path) -> None:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    runs = sorted(out_dir.glob("*.json"))
+    if not runs:
+        return
+    fig, (ax, ax2) = plt.subplots(1, 2, figsize=(11, 4))
+    for f in runs:
+        r = json.loads(f.read_text())
+        label = (f"{r['precision']} b{r['batch_size']} "
+                 f"{'warmup ' + str(r['warmup_steps']) if r['warmup_steps'] else 'no warmup'}")
+        ax.plot(r["losses"], label=label, lw=1)
+        ax2.plot(r["losses"][:40], label=label, lw=1)
+    ax.set_xlabel("step"); ax.set_ylabel("loss")
+    ax.set_title("flagship loss (full run)")
+    ax2.set_xlabel("step"); ax2.set_title("first 40 steps (spike zone)")
+    ax.legend(fontsize=7); ax2.legend(fontsize=7)
+    fig.tight_layout()
+    plot_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(plot_path, dpi=120)
+    print(f"[flagship] plot -> {plot_path}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", choices=sorted(MODEL_REGISTRY),
+                   default="smollm3-3b-l8")
+    p.add_argument("--precision", default="int8_bwd")
+    p.add_argument("--sequence-length", type=int, default=8192)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--num-steps", type=int, default=500)
+    p.add_argument("--warmup-steps", type=int, default=50)
+    p.add_argument("--peak-lr", type=float, default=3e-4)
+    p.add_argument("--spike-demo", action="store_true",
+                   help="first run a short no-warmup leg to pin the "
+                        "cold-Adam step-2 spike")
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--out-dir", default="flagship_results")
+    p.add_argument("--plot", default="plots/flagship_loss.png")
+    args = p.parse_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    out_dir = Path(args.out_dir)
+    if args.spike_demo:
+        run_leg(args.model, args.precision, args.sequence_length,
+                args.batch_size, 30, 0, args.peak_lr, out_dir)
+    run_leg(args.model, args.precision, args.sequence_length,
+            args.batch_size, args.num_steps, args.warmup_steps,
+            args.peak_lr, out_dir)
+    plot(out_dir, Path(args.plot))
+
+
+if __name__ == "__main__":
+    main()

@@ -158,3 +158,42 @@ def test_launcher_real_run(tmp_path):
     assert res.returncode == 0
     traced = list(Path(res.trace_dir).rglob("*.json.gz"))
     assert traced, f"no traces under {res.trace_dir}"
+
+
+def test_nprocs_requires_cpu_spec(tmp_path):
+    cfg = LaunchConfig(device_spec="tpu", nprocs=2, trace_root=tmp_path)
+    with pytest.raises(ValueError, match="cpu:<k>"):
+        run_training(cfg, script="zero1")
+
+
+def test_config_nprocs_key():
+    cfg = LaunchConfig.from_config(
+        {"devices": {"spec": "cpu:2", "nprocs": 2}})
+    assert cfg.nprocs == 2
+
+
+@pytest.mark.slow
+def test_launcher_multiprocess_zero1(tmp_path):
+    """The torchrun contract as a CLI capability (VERDICT r3 #5): zero1
+    over TWO real worker processes via `dts-launch run --nprocs 2` —
+    each worker gets 2 simulated devices, the strategy script's existing
+    bootstrap joins them into ONE 4-device mesh, and the A/B report runs
+    to completion in both workers (twin of `torchrun --standalone
+    --nproc_per_node=2 zero1.py`, modal_utils.py:115-119)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_training_sandbox_tpu.launch.cli",
+         "run", "--script", "zero1", "--run-name", "mp", "--num-steps", "3",
+         "--devices", "cpu:2", "--nprocs", "2", "--trace-root",
+         str(tmp_path), "--", "--scale", "100"],
+        capture_output=True, text=True, cwd=Path(__file__).parent.parent,
+        timeout=600)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    # worker 0's echoed log carries the A/B report over the global mesh
+    assert "ws=4" in r.stdout, r.stdout[-2000:]
+    assert "A/B report" in r.stdout
+    run_dirs = list(Path(tmp_path).glob("*-mp"))
+    assert run_dirs, list(Path(tmp_path).iterdir())
+    logs = sorted(p.name for p in run_dirs[0].glob("worker_*.log"))
+    assert logs == ["worker_0.log", "worker_1.log"]
+    w1 = (run_dirs[0] / "worker_1.log").read_text()
+    assert "A/B report" in w1
