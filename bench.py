@@ -94,6 +94,23 @@ KNOB_MATRIX = [
     # (r3) — the knob-space ceiling is compute-bound, not batch-bound.
     ("explicit_int8_bwd_b4x", {"matmul_precision": "int8_bwd"},
      {"reshard_after_forward": True}, 4),
+    # r4: the attack on the save_dots×int8 OOM wall — int8-QUANTIZED
+    # saved activations (ops/quant.quantized_residual): save_dots'
+    # recompute savings at ~half its activation bytes, so the crossing
+    # that OOM'd at 18.2 GB planned now fits.  Straight-through
+    # backward; forward carries per-row int8 noise (the same noise the
+    # int8 matmuls already inject).
+    ("explicit_save_dots_q8", {"remat_policy": "save_dots_q8"},
+     {"reshard_after_forward": True}, 1),
+    ("explicit_save_dots_q8_int8", {"remat_policy": "save_dots_q8",
+                                    "matmul_precision": "int8_bwd"},
+     {"reshard_after_forward": True}, 1),
+    ("explicit_save_dots_q8_int8_b2x", {"remat_policy": "save_dots_q8",
+                                        "matmul_precision": "int8_bwd"},
+     {"reshard_after_forward": True}, 2),
+    ("explicit_save_dots_q8_int8_b4x", {"remat_policy": "save_dots_q8",
+                                        "matmul_precision": "int8_bwd"},
+     {"reshard_after_forward": True}, 4),
 ]
 
 

@@ -60,6 +60,8 @@ STRATEGY_SCRIPTS = {
     "train_fsdp": "train_fsdp.py",
     "gpipe": "gpipe.py",
     "1f1b": "1f1b.py",
+    "interleaved_1f1b": "interleaved_1f1b.py",
+    "interleaved": "interleaved_1f1b.py",
     "precision": "precision_benchmark.py",
     "precision_benchmark": "precision_benchmark.py",
     "busbench": "busbench.py",

@@ -19,4 +19,5 @@ MODEL_REGISTRY = {
     "llama3.2-1b": "LLAMA32_1B",
     "llama3.1-8b": "LLAMA31_8B",
     "tiny": "TINY_LM",
+    "tiny8": "TINY_LM_L8",
 }

@@ -49,10 +49,13 @@ class KVCache(NamedTuple):
 
 
 def init_cache(cfg: T.TransformerConfig, batch: int,
-               max_len: int) -> KVCache:
+               max_len: int, tp: int = 1) -> KVCache:
+    """``tp`` > 1: the TENSOR-PARALLEL cache — each rank caches only its
+    ``n_kv/tp`` local heads (the KV memory and the per-step cache read
+    both shrink by tp, the point of TP-sharded decode)."""
     L, nkv, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
                   cfg.resolved_head_dim)
-    shape = (L, batch, max_len, nkv, hd)
+    shape = (L, batch, max_len, nkv // tp, hd)
     return KVCache(k=jnp.zeros(shape, cfg.dtype),
                    v=jnp.zeros(shape, cfg.dtype),
                    length=jnp.zeros((), jnp.int32))
@@ -89,20 +92,28 @@ def quantize_decode_params(params: dict, cfg: T.TransformerConfig) -> dict:
 
 
 def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope, li,
-                       cache: KVCache, start):
+                       cache: KVCache, start, tp_axis=None):
     """One decoder layer that READS/WRITES the cache: the training
     layer's SHARED projection/MLP helpers (``transformer._qkv_proj`` /
     ``_mlp_block`` — one implementation, no drift) with attention run
     against [0, start + S) of the cache instead of the local chunk.
-    x: (B, S, H) with S = prefill length or 1."""
+    x: (B, S, H) with S = prefill length or 1.
+
+    ``tp_axis``: Megatron tensor-parallel decode (shard_map only) —
+    ``layer`` holds this rank's head/intermediate shards
+    (``parallel.tensor.tp_specs`` layout), the cache holds only the
+    local ``n_kv/tp`` heads, and the two row-parallel outputs are psum'd
+    back into the (replicated) residual stream — the same f/g pairing
+    the training layer uses (``transformer._layer_body``)."""
     B, S, H = x.shape
     hd = cfg.resolved_head_dim
-    nq, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    tp = lax.axis_size(tp_axis) if tp_axis else 1
+    nq, nkv = cfg.num_attention_heads // tp, cfg.num_key_value_heads // tp
     dense = T._dense(cfg)
 
     r = T.rms_norm(x, layer["ln1"], cfg.rms_norm_eps)
     q, k, v = T._qkv_proj(r, layer, cfg=cfg, cos=cos, sin=sin,
-                          use_rope=use_rope)
+                          use_rope=use_rope, tp=tp)
 
     ck = lax.dynamic_update_slice(cache.k[li], k, (0, start, 0, 0))
     cv = lax.dynamic_update_slice(cache.v[li], v, (0, start, 0, 0))
@@ -122,14 +133,21 @@ def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope, li,
     probs = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum("bnqk,bknh->bqnh", probs,
                       vf.astype(jnp.float32)).astype(x.dtype)
-    x = x + dense(attn.reshape(B, S, nq * hd), layer["wo"])
+    attn_out = dense(attn.reshape(B, S, nq * hd), layer["wo"])
+    if tp_axis:
+        from ..ops import collectives as C
+        attn_out = C.all_reduce(attn_out, tp_axis)
+    x = x + attn_out
 
     r = T.rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
     mlp, _aux = T._mlp_block(r, layer, cfg=cfg)
+    if tp_axis:
+        mlp = C.all_reduce(mlp, tp_axis)
     return x + mlp, new_cache
 
 
-def _forward_cached(params, ids, cfg, cache: KVCache, start):
+def _forward_cached(params, ids, cfg, cache: KVCache, start,
+                    tp_axis=None):
     """ids (B, S) → (last-position logits (B, V) fp32, cache') using /
     refreshing the cache; ``start`` = absolute position of ids[:, 0].
     Only the LAST position's logits are computed — decoding never needs
@@ -145,7 +163,7 @@ def _forward_cached(params, ids, cfg, cache: KVCache, start):
         li, layer, use_rope = scanned
         x, (ck, cv) = _cached_layer_body(
             x, layer, cfg=cfg, cos=cos, sin=sin, use_rope=use_rope,
-            li=li, cache=cache, start=start)
+            li=li, cache=cache, start=start, tp_axis=tp_axis)
         return x, (ck, cv)
 
     idx = jnp.arange(cfg.num_hidden_layers)
@@ -159,6 +177,42 @@ def _forward_cached(params, ids, cfg, cache: KVCache, start):
         logits = (x @ T._output_embedding(params, cfg).T)[:, 0]
     new = KVCache(k=ks, v=vs, length=start + S)
     return logits.astype(jnp.float32), new
+
+
+def _generate_core(params, prompt_ids, rng, cfg: T.TransformerConfig,
+                   max_new_tokens: int, temperature: float,
+                   tp_axis=None):
+    B, S0 = prompt_ids.shape
+    S_max = S0 + max_new_tokens
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    cache = init_cache(cfg, B, S_max, tp=tp)
+    logits, cache = _forward_cached(params, prompt_ids, cfg, cache, 0,
+                                    tp_axis=tp_axis)
+
+    def pick(logits_1, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits_1, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits_1 / temperature, axis=-1).astype(jnp.int32)
+
+    tok0 = pick(logits, rng)
+
+    def step(carry, key):
+        tok, cache = carry
+        logits, cache = _forward_cached(params, tok[:, None], cfg,
+                                        cache, cache.length,
+                                        tp_axis=tp_axis)
+        nxt = pick(logits, key)
+        return (nxt, cache), nxt
+
+    # max_new_tokens - 1 scanned steps: tok0 came from the prefill
+    # logits, and each step emits the token it computes — no wasted
+    # final forward (the r3 advisor's finding on this loop).
+    keys = jax.random.split(jax.random.fold_in(rng, 1),
+                            max_new_tokens - 1)
+    (_, _), toks = lax.scan(step, (tok0, cache), keys)
+    toks = jnp.concatenate([tok0[None], toks], axis=0)
+    return toks.swapaxes(0, 1)   # (B, max_new_tokens)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
@@ -179,31 +233,43 @@ def generate(params, prompt_ids, cfg: T.TransformerConfig, *,
                          "rng=jax.random.PRNGKey(...) explicitly")
     if rng is None:
         rng = jax.random.PRNGKey(0)   # unused by greedy picks
-    B, S0 = prompt_ids.shape
-    S_max = S0 + max_new_tokens
-    cache = init_cache(cfg, B, S_max)
-    logits, cache = _forward_cached(params, prompt_ids, cfg, cache, 0)
+    return _generate_core(params, prompt_ids, rng, cfg, max_new_tokens,
+                          temperature)
 
-    def pick(logits_1, key):
-        if temperature == 0.0:
-            return jnp.argmax(logits_1, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits_1 / temperature, axis=-1).astype(jnp.int32)
 
-    tok0 = pick(logits, rng)
+def make_tp_generate(cfg: T.TransformerConfig, mesh, *, axis: str = "tp",
+                     max_new_tokens: int = 32, temperature: float = 0.0):
+    """TP-sharded decode: ``fn(params_tp, prompt_ids, rng) -> tokens``.
 
-    def step(carry, key):
-        tok, cache = carry
-        logits, cache = _forward_cached(params, tok[:, None], cfg,
-                                        cache, cache.length)
-        nxt = pick(logits, key)
-        return (nxt, cache), nxt
+    ``params_tp`` hold Megatron layer shards
+    (``parallel.tensor.shard_params_tp``: wq/wk/wv/w_gate/w_up
+    column-sharded, wo/w_down row-sharded, embed/norms replicated); the
+    KV cache holds only each rank's ``n_kv/tp`` heads, so both the
+    weight read AND the cache read of every decode step shrink by tp —
+    the multi-chip decode scaling path.  Prompt and emitted tokens are
+    replicated (every rank decodes the same stream)."""
+    from ..ops import collectives as C
+    from ..parallel.tensor import check_tp_divisibility, tp_specs
 
-    # max_new_tokens - 1 scanned steps: tok0 came from the prefill
-    # logits, and each step emits the token it computes — no wasted
-    # final forward (the r3 advisor's finding on this loop).
-    keys = jax.random.split(jax.random.fold_in(rng, 1),
-                            max_new_tokens - 1)
-    (_, _), toks = lax.scan(step, (tok0, cache), keys)
-    toks = jnp.concatenate([tok0[None], toks], axis=0)
-    return toks.swapaxes(0, 1)   # (B, max_new_tokens)
+    check_tp_divisibility(cfg, int(mesh.shape[axis]))
+
+    def core(params, prompt_ids, rng):
+        return _generate_core(params, prompt_ids, rng, cfg,
+                              max_new_tokens, temperature, tp_axis=axis)
+
+    compiled = {}   # built once on first call (specs need a params tree)
+
+    def fn(params_tp, prompt_ids, rng=None):
+        if temperature > 0.0 and rng is None:
+            raise ValueError("temperature > 0 needs an explicit rng")
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        if "jit" not in compiled:
+            from jax.sharding import PartitionSpec as P
+            compiled["jit"] = jax.jit(C.smap(
+                core, mesh,
+                in_specs=(tp_specs(params_tp, axis), P(), P()),
+                out_specs=P()))
+        return compiled["jit"](params_tp, prompt_ids, rng)
+
+    return fn

@@ -63,7 +63,14 @@ class TransformerConfig:
     # rematerialisation trade the reference's reshard_after_forward
     # comments gesture at (fsdp/train_fsdp.py:84-88), applied to FLOPs
     # instead of gathers.
-    remat_policy: str = "full"  # "full" | "save_attn" | "save_dots"
+    # "save_dots_q8" is save_dots with int8-QUANTIZED saved activations
+    # (ops/quant.quantized_residual): every projection output makes an
+    # int8 round-trip whose quantized pair is what remat keeps — half
+    # save_dots' activation bytes, same recompute savings, at the cost
+    # of per-row int8 noise in the forward (the attack on the r3
+    # save_dots×int8 OOM wall).
+    remat_policy: str = "full"
+    # "full" | "save_attn" | "save_dots" | "save_dots_q8"
     # "ring" = exact causal attention over a sequence-sharded mesh axis
     # (``sp_axis``) — context parallelism for sequences past one chip's
     # HBM; only valid inside shard_map (see parallel/sequence.py).
@@ -202,6 +209,9 @@ TINY_LM = TransformerConfig(
     vocab_size=512, hidden_size=64, intermediate_size=160,
     num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
     rope_theta=10_000.0, dtype=jnp.float32, remat=False)
+# 8-layer sibling: depth experiments (4-stage / interleaved pipelines
+# need more layers than TINY_LM's 4).
+TINY_LM_L8 = replace(TINY_LM, num_hidden_layers=8)
 
 
 # ------------------------------------------------------------------- init
@@ -339,9 +349,16 @@ def _attention_flash(q, k, v, scale: float) -> jax.Array:
 def _dense(cfg: TransformerConfig):
     """The projection matmul at the configured precision.  Precisions:
     bf16; int8 (XLA fwd); int8_pallas (fused quantize-matmul kernel fwd);
-    *_bwd variants additionally run both backward matmuls at int8."""
-    from ..ops.quant import resolve_quantized_dense
-    return resolve_quantized_dense(cfg.matmul_precision)
+    *_bwd variants additionally run both backward matmuls at int8.
+
+    Under ``remat_policy="save_dots_q8"`` every output makes the int8
+    save round-trip (``quant.quantized_residual``) so the remat policy
+    keeps the int8 pair instead of the bf16 tensor."""
+    from ..ops.quant import quantized_residual, resolve_quantized_dense
+    base = resolve_quantized_dense(cfg.matmul_precision)
+    if cfg.remat_policy == "save_dots_q8":
+        return lambda a, w: quantized_residual(base(a, w))
+    return base
 
 
 def _qkv_proj(r, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
@@ -446,6 +463,9 @@ def resolve_remat_policy(cfg: TransformerConfig):
             jax.checkpoint_policies.save_only_these_names("attn_out"),
         "save_dots":
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        # the saved tensors are the int8 pairs _dense's round-trip tagged
+        "save_dots_q8":
+            jax.checkpoint_policies.save_only_these_names("dot_q8"),
         "full": None,
     }[cfg.remat_policy]
 

@@ -455,6 +455,147 @@ def run_1f1b(stages: list[PipelineStage], x, y, n_micro: int = 4,
     return loss
 
 
+def run_interleaved_1f1b(stages: list[PipelineStage], x, y,
+                         n_micro: int = 4, lr: float = 1e-3,
+                         n_devices: int | None = None,
+                         schedule_trace: list | None = None,
+                         stats: dict | None = None) -> float:
+    """One interleaved (virtual-stage) 1F1B step — the schedule the
+    reference only NAMES in its variants-to-know list (``pp/1f1b.py:14-19``).
+
+    ``stages`` holds ``D·V`` *virtual* stages round-robin over ``D``
+    devices (virtual stage q lives on device ``q % D`` — exactly
+    ``build_pipeline``'s cycling placement), each device owning V
+    non-contiguous model chunks (Megatron's interleaving layout).  The
+    clock is the PHYSICAL one the plain scheduler's pinned reference
+    semantics don't model: per tick each DEVICE executes at most one
+    forward and one backward among its resident chunks, and work
+    enqueued this tick is visible only next tick (no same-tick cascade).
+    Priorities per device: backward = oldest microbatch first (frees
+    activations soonest); forward = deepest resident chunk first
+    (depth-first — push in-flight microbatches toward the loss before
+    admitting new ones).
+
+    Why it helps: with V chunks per device the pipeline ramp fills a
+    device after ~``(D-1)/V`` of a microbatch-traversal instead of
+    ``D-1`` — the bubble fraction falls by ~V (Megatron-LM's interleaved
+    schedule).  ``V=1`` degrades to a physical plain 1F1B, which is the
+    in-model baseline the bubble comparison tests pin against
+    ``(S-1)/(M+S-1)`` theory.
+
+    ``stats`` (optional dict) receives: ticks, bubble_fraction,
+    per_device_busy, device_max_stored (peak concurrently-stored
+    microbatch inputs summed over a device's resident chunks).
+    Returns the scaled batch loss, numerically identical to
+    ``run_gpipe``/``run_1f1b`` on the same stages (schedule changes
+    order, not math).
+    """
+    n_virtual = len(stages)
+    if n_devices is None:
+        seen: list = []
+        for s in stages:
+            if s.device not in seen:
+                seen.append(s.device)
+        n_devices = len(seen)
+    D = n_devices
+    if n_virtual % D:
+        raise ValueError(f"{n_virtual} virtual stages not divisible by "
+                         f"{D} devices")
+    for q, s in enumerate(stages):
+        if s.device != stages[q % D].device:
+            raise ValueError(
+                f"virtual stage {q} on {s.device} breaks the round-robin "
+                f"layout (expected device of stage {q % D})")
+    V = n_virtual // D
+    xs, ys = _microbatch(x, y, n_micro)
+    inv = jnp.float32(1.0 / n_micro)
+
+    fwd_q: list[deque] = [deque() for _ in range(n_virtual)]
+    bwd_q: list[deque] = [deque() for _ in range(n_virtual)]
+    stored: list[dict] = [dict() for _ in range(n_virtual)]
+    for mb in range(n_micro):
+        fwd_q[0].append((mb, jnp.asarray(xs[mb])))
+
+    mb_losses, aux_terms = [], []
+    per_dev_busy = [0] * D
+    dev_max_stored = [0] * D
+    tick = 0
+    tick_limit = 4 * (n_micro + D) * V + 64   # generous drain bound
+    while any(fwd_q[q] or bwd_q[q] for q in range(n_virtual)):
+        if tick >= tick_limit:
+            raise AssertionError(
+                f"interleaved clock failed to drain within {tick_limit} "
+                f"ticks")
+        pending = []   # (kind, q, item) applied at tick end — snapshot
+        for d in range(D):
+            resident = range(d, n_virtual, D)
+            busy = False
+            # ---- one backward: oldest microbatch first
+            cands = [(bwd_q[q][0][0], -q) for q in resident if bwd_q[q]]
+            if cands:
+                mb_min, negq = min(cands)
+                q = -negq
+                stage = stages[q]
+                mb, gout = bwd_q[q].popleft()
+                xin = stored[q].pop(mb)
+                if stage.is_last:
+                    yd = _to_stage(ys[mb], stage)
+                    stage.label_sds = jax.ShapeDtypeStruct(yd.shape,
+                                                           yd.dtype)
+                    l, gp, gx = stage.last_fwd_bwd(stage.params, xin, yd,
+                                                   inv)
+                    mb_losses.append(l)
+                else:
+                    gp, gx = stage.bwd(stage.params, xin,
+                                       _to_stage(gout, stage),
+                                       jnp.float32(stage.aux_weight) * inv)
+                stage.accumulate(gp)
+                if q > 0:
+                    pending.append((bwd_q, q - 1, (mb, gx)))
+                if schedule_trace is not None:
+                    schedule_trace.append((tick, d, q, "bwd", mb))
+                busy = True
+            # ---- one forward: deepest resident chunk first
+            fcands = [q for q in resident if fwd_q[q]]
+            if fcands:
+                q = max(fcands)
+                stage = stages[q]
+                mb, xin = fwd_q[q].popleft()
+                xin = _to_stage(xin, stage)
+                stored[q][mb] = xin
+                stage.input_sds = jax.ShapeDtypeStruct(xin.shape, xin.dtype)
+                stage.max_stored = max(stage.max_stored, len(stored[q]))
+                if stage.is_last:
+                    pending.append((bwd_q, q, (mb, None)))
+                else:
+                    out, aux = stage.fwd(stage.params, xin)
+                    pending.append((fwd_q, q + 1, (mb, out)))
+                    if stage.aux_weight:
+                        aux_terms.append(stage.aux_weight * inv * aux)
+                if schedule_trace is not None:
+                    schedule_trace.append((tick, d, q, "fwd", mb))
+                busy = True
+            per_dev_busy[d] += busy
+            dev_max_stored[d] = max(
+                dev_max_stored[d],
+                sum(len(stored[q]) for q in resident))
+        for queue, q, item in pending:
+            queue[q].append(item)
+        tick += 1
+
+    for stage in stages:
+        stage.step(lr)
+    if stats is not None:
+        stats.update(
+            ticks=tick, n_devices=D, n_virtual=V * D, v=V,
+            bubble_fraction=round(1.0 - sum(per_dev_busy) / (D * tick), 4),
+            per_device_busy=list(per_dev_busy),
+            device_max_stored=list(dev_max_stored))
+    loss = float(jnp.sum(jnp.stack(mb_losses)))
+    loss += sum(float(a) for a in aux_terms)
+    return loss
+
+
 @dataclass
 class PipeResult:
     """JSON results schema twin of ``gpipe.py:205-218``, extended with
@@ -469,6 +610,8 @@ class PipeResult:
     total_time_s: float
     avg_epoch_time_s: float
     epochs_per_s: float
+    n_stages: int = 0       # virtual-stage count for interleaved runs
+    n_micro: int = 0
     peak_memory_mb: dict = field(default_factory=dict)
     total_peak_memory_mb: float = 0.0
     # "allocator" when peak_memory_mb carries real runtime stats,
@@ -478,6 +621,9 @@ class PipeResult:
     memory_plan_mb: dict = field(default_factory=dict)
     max_stored_activations: dict = field(default_factory=dict)
     activation_mb_per_microbatch: dict = field(default_factory=dict)
+    # interleaved runs: ticks / bubble_fraction / device_max_stored from
+    # the physical per-device clock (run_interleaved_1f1b's stats)
+    schedule_stats: dict = field(default_factory=dict)
 
     def as_dict(self) -> dict:
         return asdict(self)
@@ -489,7 +635,13 @@ def train_pipeline(stages: list[PipelineStage], schedule: str,
                    lr: float = 1e-3, log: Callable | None = None) -> PipeResult:
     """Epoch loop + metrics, twin of the reference's ``__main__`` epoch loop
     and JSON dump (``1f1b.py:186-205``, ``gpipe.py:205-218``)."""
-    run = {"gpipe": run_gpipe, "1f1b": run_1f1b}[schedule]
+    sched_stats: dict = {}
+    if schedule == "interleaved":
+        def run(stages, x, y, n_micro, lr):
+            return run_interleaved_1f1b(stages, x, y, n_micro=n_micro,
+                                        lr=lr, stats=sched_stats)
+    else:
+        run = {"gpipe": run_gpipe, "1f1b": run_1f1b}[schedule]
     losses = []
     t0 = time.perf_counter()
     for epoch in range(num_epochs):
@@ -510,6 +662,8 @@ def train_pipeline(stages: list[PipelineStage], schedule: str,
         for i, s in enumerate(stages)}
     return PipeResult(
         schedule=schedule,
+        n_stages=len(stages),
+        n_micro=n_micro,
         final_loss=losses[-1],
         avg_loss=sum(losses) / len(losses),
         total_time_s=total,
@@ -523,4 +677,5 @@ def train_pipeline(stages: list[PipelineStage], schedule: str,
         max_stored_activations={f"device_{i}": s.max_stored
                                 for i, s in enumerate(stages)},
         activation_mb_per_microbatch=act_mb,
+        schedule_stats=sched_stats,
     )

@@ -33,17 +33,21 @@ def classify_failure(e: Exception) -> tuple[str, str]:
     return "error", f"{type(e).__name__}: {msg[:200]}"
 
 
-def tree_size_mb(tree: Any) -> float:
-    """Total size of all array leaves, in MB (tensor-walk twin of
+def tree_size_bytes(tree: Any) -> int:
+    """Total bytes of all array leaves (tensor-walk twin of
     ``memory.py:8-34``)."""
-    leaves = jax.tree_util.tree_leaves(tree)
     total = 0
-    for leaf in leaves:
+    for leaf in jax.tree_util.tree_leaves(tree):
         if hasattr(leaf, "nbytes"):
             total += leaf.nbytes
         elif hasattr(leaf, "size") and hasattr(leaf, "dtype"):
             total += leaf.size * jnp.dtype(leaf.dtype).itemsize
-    return total / MB
+    return total
+
+
+def tree_size_mb(tree: Any) -> float:
+    """`tree_size_bytes` in MB."""
+    return tree_size_bytes(tree) / MB
 
 
 def tree_local_size_mb(tree: Any) -> float:

@@ -116,3 +116,57 @@ def split_from_trace(trace_dir: str, top_n: int = 5) -> CommSplit | None:
         top_comm=top(comm),
         top_compute=top(compute),
     )
+
+
+# --------------------------------------------------- HLO schedule shape
+
+HLO_COLLECTIVES = ("all-gather", "reduce-scatter", "all-reduce",
+                   "collective-permute", "all-to-all")
+
+
+def hlo_computations(txt: str) -> dict[str, list[str]]:
+    """Optimized-HLO text -> {computation name: instruction lines}.
+    Header args may contain nested parens (tuple types), hence the
+    greedy match up to the arrow."""
+    comps: dict[str, list[str]] = {}
+    cur = None
+    for line in txt.splitlines():
+        m = re.match(r"^(?:ENTRY\s+)?%?([\w\.\-]+)\s*\(.*\)\s*->.*\{",
+                     line)
+        if m:
+            cur = m.group(1)
+            comps[cur] = []
+        elif cur is not None:
+            comps[cur].append(line)
+    return comps
+
+
+def while_bodies(txt: str) -> set[str]:
+    """Names of computations used as while-loop bodies."""
+    return {m.group(1) for m in re.finditer(r"body=%?([\w\.\-]+)", txt)}
+
+
+def collective_placement(txt: str) -> dict:
+    """Per collective kind: how many sit inside while-loop bodies vs
+    hoisted outside, plus async start/done pair count — the schedule-
+    shape evidence behind ``scripts/overlap_analysis.py`` (the ZeRO-3
+    in-loop re-gather vs ZeRO-2 hoisted gather distinction, reference
+    ``fsdp/train_fsdp.py:84-88``)."""
+    comps = hlo_computations(txt)
+    bodies = while_bodies(txt)
+    out: dict = {}
+    for kind in HLO_COLLECTIVES:
+        def count(lines):
+            return sum(1 for l in lines
+                       if f"{kind}(" in l or f"{kind}-start(" in l)
+        in_loop = sum(count(lines) for name, lines in comps.items()
+                      if name in bodies)
+        total = sum(count(lines) for lines in comps.values())
+        if total:
+            out[kind] = {"total": total, "in_loop_body": in_loop,
+                         "hoisted": total - in_loop}
+    # opcode-anchored: a raw substring count would also hit the
+    # instruction's own %name and the operand reference in the paired
+    # -done line (~3 hits per actual pair)
+    out["async_pairs"] = txt.count("all-gather-start(")
+    return out

@@ -21,7 +21,12 @@ def main(schedule: str, argv=None):
 
     p = argparse.ArgumentParser()
     p.add_argument("--cpu-devices", type=int, default=0)
-    p.add_argument("--n-stages", type=int, default=2)
+    p.add_argument("--n-stages", type=int, default=2,
+                   help="stage count; for the interleaved schedule this "
+                        "is the TOTAL virtual-stage count (D*V)")
+    p.add_argument("--virtual-per-device", type=int, default=2,
+                   help="interleaved only: V chunks per device "
+                        "(n_stages/V devices round-robin)")
     p.add_argument("--n-micro", type=int, default=4)
     p.add_argument("--model", choices=["mlp"] + sorted(MODELS),
                    default="mlp",
@@ -49,9 +54,19 @@ def main(schedule: str, argv=None):
         rest, batch_size=64, num_epochs=16,
         sequence_length=256 if args.model != "mlp" else 8192)
     key = set_seed(cfg.seed)
+    devices = None
+    if schedule == "interleaved":
+        v = args.virtual_per_device
+        if args.n_stages % v:
+            raise SystemExit(f"--n-stages {args.n_stages} not divisible "
+                             f"by --virtual-per-device {v}")
+        n_dev = args.n_stages // v
+        devices = jax.local_devices()[:n_dev]
+        if len(devices) < n_dev:
+            raise SystemExit(f"need {n_dev} devices, have {len(devices)}")
     if args.model == "mlp":
         params = pp_toy_mlp(key)
-        stages = build_pipeline(params, args.n_stages)
+        stages = build_pipeline(params, args.n_stages, devices=devices)
         width_in, width_out = PP_TOY_SIZES[0], PP_TOY_SIZES[-1]
 
         def make_batch(epoch):
@@ -63,7 +78,8 @@ def main(schedule: str, argv=None):
     else:
         mcfg: T.TransformerConfig = getattr(T, MODELS[args.model])
         params = T.init_params(key, mcfg)
-        stages = build_transformer_pipeline(params, mcfg, args.n_stages)
+        stages = build_transformer_pipeline(params, mcfg, args.n_stages,
+                                            devices=devices)
 
         def make_batch(epoch):
             # packed-window contract (inputs = w[:-1], labels = w[1:]),

@@ -38,26 +38,56 @@ def _load_json_rows(dirname: str, pattern: str = "*.json") -> list[dict]:
     return rows
 
 
-def load_precision(dirname: str) -> list[dict]:
+def load_precision(dirname: str) -> tuple[list[dict], list[dict]]:
+    """(measured rows, failure rows).  Last write wins per
+    (model, precision, seq, devices, batch) — the r4 sweeps carry a
+    batch dimension (VERDICT r3 #2: batch-1 defaults understated every
+    family)."""
     rows = _load_json_rows(dirname, "summary_*.json")
-    # last write wins per (model, precision, seq, devices) key
-    dedup = {}
+    dedup, fails = {}, {}
+    # files glob in timestamp order, so iteration is oldest -> newest:
+    # the newest verdict for a key wins ACROSS the two buckets too (a
+    # config that OOM'd once but succeeds after a fix must not be
+    # published as both a result and an edge).
     for r in rows:
-        dedup[(r["model"], r["precision"], r["sequence_length"],
-               r["num_devices"])] = r
-    return list(dedup.values())
+        key = (r["model"], r["precision"], r["sequence_length"],
+               r.get("num_devices", 1), r.get("batch_size"))
+        if "failure" in r or "error" in r:
+            fails[key] = r
+            dedup.pop(key, None)
+        else:
+            dedup[key] = r
+            fails.pop(key, None)
+    return list(dedup.values()), list(fails.values())
 
 
-def precision_tables(rows: list[dict]) -> str:
-    if not rows:
+def best_by_batch(rows: list[dict]) -> list[dict]:
+    """Collapse the batch dimension: per (model, precision, seq,
+    devices) keep the best-throughput batch, remembering it in
+    ``best_batch``."""
+    best: dict = {}
+    for r in rows:
+        key = (r["model"], r["precision"], r["sequence_length"],
+               r.get("num_devices", 1))
+        if key not in best or (r["tokens_per_second"]
+                               > best[key]["tokens_per_second"]):
+            best[key] = {**r, "best_batch": r.get("batch_size")}
+    return list(best.values())
+
+
+def precision_tables(all_rows: list[dict], fails: list[dict]) -> str:
+    if not all_rows:
         return "_no precision summaries found_\n"
+    rows = best_by_batch(all_rows)
     models = sorted({r["model"] for r in rows})
     seqs = sorted({r["sequence_length"] for r in rows})
     devs = sorted({r["num_devices"] for r in rows})
     precisions = list(dict.fromkeys(r["precision"] for r in rows))
     by = {(r["model"], r["precision"], r["sequence_length"],
            r["num_devices"]): r for r in rows}
-    out = []
+    out = ["Each cell is that configuration's BEST measured batch "
+           "(the `@bN` tag; batch swept 1/2/4/8 to the OOM edge — "
+           "VERDICT r3 #2's re-calibration of the old batch-1 rows).\n"]
     for metric, fmt, title in (
             ("tokens_per_second", "{:.0f}", "tokens/sec"),
             ("tflops_per_device", "{:.2f}", "TFLOPS/device"),
@@ -73,8 +103,12 @@ def precision_tables(rows: list[dict]) -> str:
                     if not any(vals.values()):
                         continue
                     cells = [m, str(s), str(d)]
-                    cells += [fmt.format(vals[p][metric]) if vals[p] else "—"
-                              for p in precisions]
+                    cells += [
+                        (fmt.format(vals[p][metric])
+                         + (f" @b{vals[p]['best_batch']}"
+                            if vals[p].get("best_batch") else ""))
+                        if vals[p] else "—"
+                        for p in precisions]
                     ints = [vals[p][metric] for p in precisions
                             if p != "bf16" and vals[p]]
                     if vals.get("bf16") and vals["bf16"][metric] and ints:
@@ -84,9 +118,12 @@ def precision_tables(rows: list[dict]) -> str:
                         cells.append("—")
                     out.append("| " + " | ".join(cells) + " |")
         out.append("")
-    out.append("### peak memory (model + optimizer, MB per device)\n")
-    out += ["| model | seq | devices | precision | model MB | optimizer MB |",
-            "|---|---|---|---|---|---|"]
+    out.append("### memory at the best batch (compile plan = argument "
+               "buffers + XLA temps, GB — outputs alias the donated "
+               "args; model + optimizer MB per device)\n")
+    out += ["| model | seq | devices | precision | best batch | plan GB "
+            "| model MB | optimizer MB |",
+            "|---|---|---|---|---|---|---|---|"]
     for m in models:
         for s in seqs:
             for d in devs:
@@ -94,10 +131,35 @@ def precision_tables(rows: list[dict]) -> str:
                     r = by.get((m, p, s, d))
                     if r:
                         pm = r.get("peak_memory", {})
-                        out.append(f"| {m} | {s} | {d} | {p} | "
-                                   f"{pm.get('model_mb', 0):.0f} | "
-                                   f"{pm.get('optimizer_mb', 0):.0f} |")
+                        plan = pm.get("memory_plan_gb")
+                        if (plan is not None
+                                and pm.get("plan_formula") != "args+temps"):
+                            # older artifacts counted donated outputs on
+                            # top of the argument buffers they alias —
+                            # subtract the (model + optimizer) state once
+                            plan = round(plan - (pm.get("model_mb", 0)
+                                         + pm.get("optimizer_mb", 0))
+                                         / 1024, 2)
+                        out.append(
+                            f"| {m} | {s} | {d} | {p} | "
+                            f"{r.get('best_batch', '—')} | "
+                            f"{plan if plan is not None else '—'} | "
+                            f"{pm.get('model_mb', 0):.0f} | "
+                            f"{pm.get('optimizer_mb', 0):.0f} |")
     out.append("")
+    if fails:
+        out.append("### OOM edges (XLA's own verdict; non-OOM failures "
+                   "are never published as edges)\n")
+        out += ["| model | seq | precision | batch | kind |",
+                "|---|---|---|---|---|"]
+        for r in sorted(fails, key=lambda r: (r["model"],
+                                              r["sequence_length"],
+                                              r["precision"],
+                                              r.get("batch_size") or 0)):
+            out.append(f"| {r['model']} | {r['sequence_length']} | "
+                       f"{r['precision']} | {r.get('batch_size', '—')} | "
+                       f"{r.get('failure', 'error')} |")
+        out.append("")
     return "\n".join(out)
 
 
@@ -131,13 +193,30 @@ def longctx_table(rows: list[dict]) -> str:
 def decode_table(rows: list[dict]) -> str:
     if not rows:
         return "_no decode benchmark found_\n"
-    out = ["| model | platform | batch | prompt | new | steady tok/s | "
-           "ms/token/seq |", "|---|---|---|---|---|---|---|"]
+    out = ["Decode is weight-read-bound: the roofline column is "
+           "`weight_bytes / HBM bandwidth` per step; int8 rows store "
+           "weights AS int8 (`quantize_decode_params`), halving the "
+           "floor.\n",
+           "| model | precision | batch | prompt | new | weight GiB | "
+           "steady tok/s | ms/step | roofline ms | roofline frac | "
+           "prefill+1 s |",
+           "|---|---|---|---|---|---|---|---|---|---|---|"]
     for r in rows:
-        out.append(f"| {r['model']} | {r['platform']} | {r['batch']} | "
-                   f"{r['prompt_len']} | {r['new_tokens']} | "
-                   f"{r.get('steady_decode_tokens_per_sec', '—')} | "
-                   f"{r.get('steady_ms_per_token_per_seq', '—')} |")
+        if "failure" in r or "error" in r:
+            out.append(f"| {r['model']} | {r.get('precision', '—')} | "
+                       f"{r.get('batch', '—')} | {r.get('prompt_len', '—')}"
+                       f" | — | — | — | — | — | — | "
+                       f"{r.get('failure', 'error')} |")
+            continue
+        out.append(
+            f"| {r['model']} | {r.get('precision', 'bf16')} | "
+            f"{r['batch']} | {r['prompt_len']} | {r['new_tokens']} | "
+            f"{r.get('weight_gib', '—')} | "
+            f"{r.get('steady_decode_tokens_per_sec', '—')} | "
+            f"{r.get('steady_ms_per_step', r.get('steady_ms_per_token_per_seq', '—'))} | "
+            f"{r.get('weight_read_roofline_ms_per_step', '—')} | "
+            f"{r.get('roofline_fraction', '—')} | "
+            f"{r.get('prefill_plus_1_s', '—')} |")
     out.append("")
     return "\n".join(out)
 
@@ -193,9 +272,10 @@ def load_pp(dirname: str) -> list[dict]:
 def pp_table(rows: list[dict]) -> str:
     if not rows:
         return "_no pp result JSONs found_\n"
-    out = ["| schedule | final loss | avg epoch s | epochs/s | "
-           "mem/stage MB | max stored acts | act MB/microbatch |",
-           "|---|---|---|---|---|---|---|"]
+    out = ["| schedule | stages | micro | final loss | avg epoch s | "
+           "epochs/s | mem/stage MB | max stored acts | "
+           "act MB/microbatch | bubble |",
+           "|---|---|---|---|---|---|---|---|---|---|"]
     for r in rows:
         # allocator peaks when available, else the compile-time plan
         # (memory_source tags which; this substrate exposes no runtime
@@ -206,13 +286,99 @@ def pp_table(rows: list[dict]) -> str:
                else r.get("memory_plan_mb", {}))
         fmt = lambda d: "/".join(f"{v:.0f}" for v in d.values()) \
             if d else "—"
+        stats = r.get("schedule_stats") or {}
+        bubble = stats.get("bubble_fraction")
+        stages = r.get("n_stages") or len(r.get("memory_plan_mb", {})) \
+            or "—"
+        if stats.get("v"):
+            stages = (f"{stats['n_devices']}dev×{stats['v']}v")
         out.append(
-            f"| {r['schedule']} | {r['final_loss']:.4f} | "
+            f"| {r['schedule']} | {stages} | {r.get('n_micro') or '—'} | "
+            f"{r['final_loss']:.4f} | "
             f"{r['avg_epoch_time_s']:.3f} | {r['epochs_per_s']:.2f} | "
             f"{fmt(mem)}"
             f"{'' if r.get('memory_source', 'allocator') == 'allocator' else ' (plan)'} | "
             f"{fmt(r.get('max_stored_activations', {}))} | "
-            f"{'/'.join(str(v) for v in r.get('activation_mb_per_microbatch', {}).values()) or '—'} |")
+            f"{'/'.join(str(v) for v in r.get('activation_mb_per_microbatch', {}).values()) or '—'} | "
+            f"{bubble if bubble is not None else '—'} |")
+    out.append("")
+    return "\n".join(out)
+
+
+def flagship_section(dirname: str = "flagship_results") -> str:
+    runs = _load_json_rows(dirname)
+    if not runs:
+        return "_no flagship training runs found_\n"
+    out = ["Long-horizon proof that training *learns* (VERDICT r3 #1): "
+           "every-step loss series with warmup+cosine LR; the no-warmup "
+           "leg pins the cold-Adam early-step spike the schedule kills. "
+           "Full series + plot: `flagship_results/`, "
+           "`plots/flagship_loss.png`.\n",
+           "| model | precision | seq | batch | steps | warmup | "
+           "loss first | max(first 20) | final (mean last 20) | tok/s |",
+           "|---|---|---|---|---|---|---|---|---|---|"]
+    for r in sorted(runs, key=lambda r: (r["precision"],
+                                         r["warmup_steps"])):
+        out.append(
+            f"| {r['model']} | {r['precision']} | {r['sequence_length']} "
+            f"| {r['batch_size']} | {r['num_steps']} | "
+            f"{r['warmup_steps'] or '—'} | {r['loss_first']:.3f} | "
+            f"{r['loss_max_first20']:.3f} | "
+            f"{r['loss_final_mean20']:.3f} | "
+            f"{r['tokens_per_second']:.0f} |")
+    out.append("")
+    return "\n".join(out)
+
+
+def moe_quality_section(dirname: str = "moe_results") -> str:
+    rows = []
+    for f in sorted(glob.glob(f"{dirname}/quality_ab_*.json")):
+        rows.append(json.load(open(f)))
+    if not rows:
+        return ""
+    out = ["## MoE quality A/B (`scripts/moe_quality_ab.py`)",
+           "",
+           "Dense vs MoE cf 2.0 vs cf 1.0 at MATCHED wall-clock, same "
+           "seeded stream, warmup+cosine — the quality evidence behind "
+           "the MoE throughput headline (VERDICT r3 #1c).  Drop rate is "
+           "measured with the dispatch's own capacity rule on the LIVE "
+           "router every eval step.  Plot: `plots/moe_quality_ab.png`.",
+           ""]
+    for d in rows:
+        out += [f"Platform {d['platform']}, budget "
+                f"{d['seconds_budget']:.0f}s per leg:", "",
+                "| leg | steps | tok/s | final eval loss | Δ vs dense | "
+                "final drop rate |",
+                "|---|---|---|---|---|---|"]
+        legs = {leg["name"]: leg for leg in d["legs"]}
+        for name, v in d["verdict"].items():
+            drop = v.get("final_drop_rate")
+            out.append(
+                f"| {name} | {legs[name]['steps']} | "
+                f"{v['tokens_per_second']:.0f} | "
+                f"{v['final_eval_loss']:.4f} | "
+                f"{v['delta_vs_dense']:+.4f} | "
+                f"{f'{drop:.3f}' if drop is not None else '—'} |")
+        out.append("")
+    return "\n".join(out)
+
+
+def overlap_section(path: str = "ddp_results/overlap_analysis.json") -> str:
+    try:
+        d = json.load(open(path))
+    except OSError:
+        return ""
+    out = ["## FSDP gather-schedule shapes "
+           "(`scripts/overlap_analysis.py`)",
+           "",
+           "Where the compiled schedules put the per-layer gathers "
+           "(in-loop re-gather = ZeRO-3, hoisted = ZeRO-2) and whether "
+           "the in-loop operands are loop-invariant (the prefetchable "
+           "shape XLA:TPU's collective pipeliner overlaps).  Full "
+           f"verdict: `{path}`.",
+           ""]
+    for s in d.get("schedule_shapes", []):
+        out.append(f"* {s}")
     out.append("")
     return "\n".join(out)
 
@@ -388,7 +554,7 @@ def main(argv=None):
                    help="additionally render PNG charts under plots/")
     args = p.parse_args(argv)
 
-    prec = load_precision(args.precision_dir)
+    prec, prec_fails = load_precision(args.precision_dir)
     pp = load_pp(args.pp_dir)
     longctx = load_longctx(args.longctx_dir)
     moe = _load_json_rows(args.moe_dir)
@@ -399,13 +565,16 @@ def main(argv=None):
         "`python scripts/analyze_results.py` — the twin of the reference's "
         "`fp8/visualize_code.ipynb` analysis pass.",
         "",
-        "## Precision sweep (model × seq × precision)",
+        "## Flagship training runs (`scripts/train_flagship.py`)",
+        "",
+        flagship_section(),
+        "## Precision sweep (model × seq × precision, batch-swept)",
         "",
         "`int8` = dynamic-absmax int8 forward matmuls; `int8_bwd` "
         "additionally quantizes both backward matmuls (the full torchao "
         "dynamic recipe at v5e's native low precision).",
         "",
-        precision_tables(prec),
+        precision_tables(prec, prec_fails),
         "## Pipeline schedules (GPipe vs 1F1B)",
         "",
         pp_table(pp),
@@ -427,12 +596,14 @@ def main(argv=None):
         "FLOPs." + moe_drop_note(args.moe_dir),
         "",
         moe_table(moe),
+        moe_quality_section(args.moe_dir),
         "## Autoregressive decode (`scripts/decode_bench.py`)",
         "",
         decode_table(_load_json_rows(args.decode_dir)),
+        overlap_section(),
     ]
     if args.plots:
-        pngs = write_plots(prec, longctx, moe)
+        pngs = write_plots(best_by_batch(prec), longctx, moe)
         doc += ["## Plots", ""] + [f"![{Path(f).stem}]({f})" for f in pngs]
         doc.append("")
         print(f"[analyze] plots: {', '.join(pngs)}")

@@ -1,10 +1,20 @@
-"""Autoregressive decode throughput on the local chip.
+"""Autoregressive decode benchmark: precision × batch × new-tokens sweep
+with prefill/decode split and the HBM roofline stated.
 
-The inference face of the framework (models/generate.py): prefill one
-batch of prompts, then measure steady-state cached decode tokens/s on
-the flagship geometry.  Writes ``decode_results/decode_<platform>.json``.
+The inference face of the framework (``models/generate.py``).  Decode at
+these shapes is weight-read-bound: every step reads every weight byte,
+so the floor is ``weight_bytes / HBM_bandwidth`` per step — which is why
+the int8 rows (``quantize_decode_params``: weights STORED int8, half the
+bytes) are the headline.  Each row reports measured ms/token/seq next to
+its roofline and the achieved fraction.
 
-    python scripts/decode_bench.py [--batch 8] [--new 128]
+  * ``--sweep``: precision {bf16, int8} × batch {1, 8, 32} × the default
+    new-tokens, plus a long-prompt (≥2048) prefill/decode split row.
+  * single run: ``--precision int8 --batch 8 --prompt 2048 --new 128``.
+
+Writes ``decode_results/decode_<platform>.json`` (a list of rows).
+
+    python scripts/decode_bench.py --sweep
 """
 
 from __future__ import annotations
@@ -17,56 +27,132 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+# v5e HBM ~819 GB/s; used only for the roofline column.
+HBM_GBPS = {"tpu": 819.0}
 
-def main(argv=None):
-    p = argparse.ArgumentParser()
-    p.add_argument("--model", default="SMOLLM3_3B_L8")
-    p.add_argument("--batch", type=int, default=8)
-    p.add_argument("--prompt", type=int, default=128)
-    p.add_argument("--new", type=int, default=128)
-    p.add_argument("--out-dir", default="decode_results")
-    args = p.parse_args(argv)
 
+def weight_bytes(params) -> int:
+    from distributed_training_sandbox_tpu.utils.memory import (
+        tree_size_bytes)
+    return tree_size_bytes(params)
+
+
+def run_one(cfg, params, precision: str, batch: int, prompt_len: int,
+            new_tokens: int, platform: str) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from distributed_training_sandbox_tpu.models import transformer as T
     from distributed_training_sandbox_tpu.models.generate import generate
 
-    cfg = getattr(T, args.model)
-    params = T.init_params(jax.random.PRNGKey(0), cfg)
     prompt = jax.random.randint(jax.random.PRNGKey(1),
-                                (args.batch, args.prompt), 0,
+                                (batch, prompt_len), 0,
                                 cfg.vocab_size, jnp.int32)
-
     # two windows — prefill+1 token vs prefill+N tokens — so the
     # STEADY-STATE decode rate is (N−1)·B / (tN − t1), prefill excluded.
-    for n in (1, args.new):              # compile both programs first
+    for n in (1, new_tokens):            # compile both programs first
         np.asarray(generate(params, prompt, cfg, max_new_tokens=n))
     p2 = jnp.roll(prompt, 1, axis=1)
     t0 = time.perf_counter()
     np.asarray(generate(params, p2, cfg, max_new_tokens=1))
     t1 = time.perf_counter() - t0
     t0 = time.perf_counter()
-    np.asarray(generate(params, p2, cfg, max_new_tokens=args.new))
+    np.asarray(generate(params, p2, cfg, max_new_tokens=new_tokens))
     tN = time.perf_counter() - t0
-    steady = (args.new - 1) * args.batch / max(tN - t1, 1e-9)
+    step_s = (tN - t1) / max(new_tokens - 1, 1)
+    steady = (new_tokens - 1) * batch / max(tN - t1, 1e-9)
+
+    wb = weight_bytes(params)
+    bw = HBM_GBPS.get(platform)
+    roofline_ms = wb / (bw * 1e9) * 1e3 if bw else None
     row = {
-        "model": args.model, "platform": jax.devices()[0].platform,
-        "batch": args.batch, "prompt_len": args.prompt,
-        "new_tokens": args.new,
+        "precision": precision, "batch": batch, "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "weight_gib": round(wb / 2**30, 3),
         "prefill_plus_1_s": round(t1, 3),
         "total_s": round(tN, 3),
         "steady_decode_tokens_per_sec": round(steady, 1),
-        "steady_ms_per_token_per_seq": round(
-            (tN - t1) / (args.new - 1) * 1e3, 2),
+        "steady_ms_per_step": round(step_s * 1e3, 2),
+        "steady_ms_per_token_per_seq": round(step_s * 1e3, 2),
+        "weight_read_roofline_ms_per_step": (round(roofline_ms, 2)
+                                             if roofline_ms else None),
+        "roofline_fraction": (round(roofline_ms / (step_s * 1e3), 3)
+                              if roofline_ms else None),
     }
-    print(f"[decode] {row}")
+    print(f"[decode] {precision} b{batch} p{prompt_len} n{new_tokens}: "
+          f"{row['steady_ms_per_step']} ms/step "
+          f"({row['steady_decode_tokens_per_sec']:.0f} tok/s, "
+          f"roofline {row['weight_read_roofline_ms_per_step']} ms, "
+          f"{row['roofline_fraction']})", flush=True)
+    return row
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="SMOLLM3_3B_L8")
+    p.add_argument("--precision", choices=["bf16", "int8"], default="bf16")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt", type=int, default=128)
+    p.add_argument("--new", type=int, default=128)
+    p.add_argument("--sweep", action="store_true")
+    p.add_argument("--out-dir", default="decode_results")
+    args = p.parse_args(argv)
+
+    import jax
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.models.generate import (
+        quantize_decode_params)
+
+    cfg = getattr(T, args.model)
+    platform = jax.devices()[0].platform
+    # Param sets build lazily per precision GROUP and the previous set is
+    # dropped first — holding bf16 (~6 GiB) and int8 (~3 GiB) copies of a
+    # 3B model simultaneously would distort the b32 rows' OOM behavior.
+    param_cache: dict = {}
+
+    def params_for(precision: str):
+        if precision not in param_cache:
+            param_cache.clear()
+            bf16 = T.init_params(jax.random.PRNGKey(0), cfg)
+            param_cache[precision] = (
+                bf16 if precision == "bf16"
+                else quantize_decode_params(bf16, cfg))
+            if precision != "bf16":
+                del bf16
+        return param_cache[precision]
+
+    rows = []
     out_dir = Path(args.out_dir)
     out_dir.mkdir(exist_ok=True)
-    path = out_dir / f"decode_{jax.devices()[0].platform}.json"
-    path.write_text(json.dumps(row, indent=1))
+    path = out_dir / f"decode_{platform}.json"
+
+    if args.sweep:
+        # grouped by precision so the lazy param cache rebuilds once;
+        # the (8, 2048) cell is the long-prompt prefill/decode split
+        cells = [(1, args.prompt), (8, args.prompt), (32, args.prompt),
+                 (8, 2048)]
+        grid = [(prec, b, plen, args.new)
+                for prec in ("bf16", "int8") for b, plen in cells]
+    else:
+        grid = [(args.precision, args.batch, args.prompt, args.new)]
+
+    for prec, b, plen, new in grid:
+        try:
+            rows.append({"model": args.model, "platform": platform,
+                         **run_one(cfg, params_for(prec), prec, b, plen,
+                                   new, platform)})
+        except Exception as e:
+            from distributed_training_sandbox_tpu.utils import (
+                classify_failure)
+            kind, msg = classify_failure(e)
+            rows.append({"model": args.model, "precision": prec,
+                         "batch": b, "prompt_len": plen,
+                         "failure": kind, "error": msg})
+            print(f"[decode] {prec} b{b} p{plen} {kind.upper()}: "
+                  f"{msg[:120]}", flush=True)
+        path.write_text(json.dumps(rows, indent=1))
+
     print(f"[decode] wrote {path}")
+    return rows
 
 
 if __name__ == "__main__":

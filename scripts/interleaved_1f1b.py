@@ -1,0 +1,20 @@
+"""Interleaved (virtual-stage) 1F1B pipeline — the schedule the reference
+names but never builds (``pp/1f1b.py:14-19``).  ``--n-stages`` is the
+TOTAL virtual-stage count; ``--virtual-per-device`` (V) sets how many
+non-contiguous chunks each of the n_stages/V devices owns.  The JSON adds
+``schedule_stats``: ticks, measured bubble fraction (physical per-device
+clock), and per-device stored-activation high-water.
+
+    python scripts/interleaved_1f1b.py --cpu-devices 4 --n-stages 8 \
+        --virtual-per-device 2 --n-micro 8
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from _pp_driver import main  # noqa: E402
+
+if __name__ == "__main__":
+    main("interleaved")

@@ -83,8 +83,11 @@ def run_one(model: str, precision: str, seq_len: int, num_steps: int,
     # dispatch cache — calling `step` again would compile twice).
     step = step.lower(shards, opt, batch).compile()
     ma = step.memory_analysis()
-    plan_gb = (ma.argument_size_in_bytes + ma.temp_size_in_bytes
-               + ma.output_size_in_bytes) / 2**30
+    # args + temps only: params/opt are DONATED, so outputs alias the
+    # argument buffers — adding output_size would double-count the
+    # whole model+optimizer state.
+    plan_gb = (ma.argument_size_in_bytes
+               + ma.temp_size_in_bytes) / 2**30
 
     flops_tok = get_model_flops_per_token(mcfg, seq_len)
     tracker = PerformanceTracker(warmup_steps=min(3, num_steps - 1),
@@ -101,7 +104,8 @@ def run_one(model: str, precision: str, seq_len: int, num_steps: int,
                              params=shards, opt_state=opt,
                              printer=log_lines.append)
     log_lines.append(f"[memory-plan] {plan_gb:.2f} GB "
-                     "(compile-time: args+temps+outputs)")
+                     "(compile-time: args+temps; donated outputs alias "
+                     "the argument buffers)")
 
     result = {
         "model": model,
@@ -115,6 +119,7 @@ def run_one(model: str, precision: str, seq_len: int, num_steps: int,
         "avg_loss": metrics.get("avg_loss"),
         "peak_memory": {
             "memory_plan_gb": round(plan_gb, 2),
+            "plan_formula": "args+temps",   # donated outputs alias args
             "model_mb": mem["model_mb"],
             "optimizer_mb": mem["optimizer_mb"],
         },

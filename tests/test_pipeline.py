@@ -140,3 +140,93 @@ def test_train_pipeline_result_schema(setup):
         assert k in d
     assert d["schedule"] == "1f1b"
     assert d["epochs_per_s"] > 0
+
+
+# ------------------------------------------------- interleaved 1F1B
+
+def test_interleaved_matches_monolithic(setup):
+    """Interleaved (virtual-stage) 1F1B is the same math again: one step
+    over 4 virtual stages on 2 devices == one monolithic Adam step."""
+    from distributed_training_sandbox_tpu.parallel.pipeline import (
+        run_interleaved_1f1b)
+
+    params, x, y = setup
+    devs = jax.local_devices()[:2]
+    stages = build_pipeline(params, n_stages=4, devices=devs)
+    loss = run_interleaved_1f1b(stages, x, y, n_micro=N_MICRO)
+    ref_params, ref_losses = monolithic_steps(params, x, y, 1)
+    assert loss == pytest.approx(ref_losses[0], rel=1e-5)
+    flat = [l for s in stages for l in s.params]
+    for got, want in zip(jax.tree.leaves(flat), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+
+def test_interleaved_tick_trace_pinned(setup):
+    """The D=2, V=2, M=2 schedule, pinned tick by tick.  Properties the
+    pin encodes: snapshot semantics (an output enqueued at tick t is
+    consumed at t+1, never same-tick), depth-first forward priority,
+    oldest-microbatch-first backward, at most one fwd + one bwd per
+    DEVICE per tick."""
+    from distributed_training_sandbox_tpu.parallel.pipeline import (
+        run_interleaved_1f1b)
+
+    params, x, y = setup
+    devs = jax.local_devices()[:2]
+    stages = build_pipeline(params, n_stages=4, devices=devs)
+    trace = []
+    stats = {}
+    run_interleaved_1f1b(stages, x, y, n_micro=2, schedule_trace=trace,
+                         stats=stats)
+    # (tick, device, virtual_stage, op, mb)
+    for tick, d, q, op, mb in trace:
+        assert q % 2 == d                       # round-robin residency
+    # per-(tick, device): at most one fwd and one bwd
+    from collections import Counter
+    per = Counter((t, d, op) for t, d, q, op, mb in trace)
+    assert max(per.values()) == 1
+    # a microbatch advances one virtual stage per tick: mb0 hits stage q
+    # at tick q; the last stage's bwd fires the tick after its fwd
+    fwd_ticks = {(q, mb): t for t, d, q, op, mb in trace if op == "fwd"}
+    assert [fwd_ticks[(q, 0)] for q in range(4)] == [0, 1, 2, 3]
+    assert [fwd_ticks[(q, 1)] for q in range(4)] == [1, 2, 3, 4]
+    bwd_ticks = {(q, mb): t for t, d, q, op, mb in trace if op == "bwd"}
+    assert bwd_ticks[(3, 0)] == 4               # snapshot: not tick 3
+    # backward relays downward one stage per tick, oldest mb first
+    assert [bwd_ticks[(q, 0)] for q in (3, 2, 1, 0)] == [4, 5, 6, 7]
+    assert stats["ticks"] == max(t for t, *_ in trace) + 1
+
+
+def test_interleaving_cuts_bubble(setup):
+    """Same devices, same microbatches: V=2 must beat V=1 (the physical
+    plain-1F1B baseline) on bubble fraction — the point of the schedule
+    (Megatron interleaving; the reference names it at pp/1f1b.py:14-19).
+    The V=1 baseline itself must sit near (S-1)/(M+S-1) theory."""
+    from distributed_training_sandbox_tpu.parallel.pipeline import (
+        run_interleaved_1f1b)
+
+    params, x, y = setup
+    devs = jax.local_devices()[:2]
+    M = 8
+    plain, inter = {}, {}
+    s1 = build_pipeline(params, n_stages=2, devices=devs)
+    run_interleaved_1f1b(s1, x, y, n_micro=M, stats=plain)
+    s2 = build_pipeline(params, n_stages=4, devices=devs)
+    run_interleaved_1f1b(s2, x, y, n_micro=M, stats=inter)
+    assert plain["v"] == 1 and inter["v"] == 2
+    assert inter["bubble_fraction"] < plain["bubble_fraction"], (plain,
+                                                                 inter)
+    theory = (2 - 1) / (M + 2 - 1)
+    assert plain["bubble_fraction"] == pytest.approx(theory, abs=0.05), (
+        plain, theory)
+
+
+def test_interleaved_rejects_broken_layout(setup):
+    from distributed_training_sandbox_tpu.parallel.pipeline import (
+        run_interleaved_1f1b)
+
+    params, x, y = setup
+    devs = jax.local_devices()[:3]
+    stages = build_pipeline(params, n_stages=4, devices=devs)  # 4 % 3 != 0
+    with pytest.raises(ValueError, match="round-robin|divisible"):
+        run_interleaved_1f1b(stages, x, y, n_micro=2, n_devices=3)

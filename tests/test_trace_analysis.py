@@ -79,3 +79,40 @@ def test_split_from_real_trace(tmp_path, mesh8):
     assert sp.comm_us > 0
     assert sp.compute_us > 0
     assert 0.0 < sp.comm_fraction < 1.0
+
+
+def test_collective_placement_schedule_shapes(mesh8):
+    """The HLO schedule-shape parser (behind scripts/overlap_analysis.py)
+    must recover the reshard knob's defining difference: reshard=True
+    re-gathers per layer INSIDE the scan while-body (ZeRO-3), while
+    reshard=False hoists every gather out of the loop (ZeRO-2) —
+    reference ``fsdp/train_fsdp.py:84-88``."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.parallel import fsdp
+    from distributed_training_sandbox_tpu.utils.trace_analysis import (
+        collective_placement)
+
+    cfg = T.TINY_LM
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    shards = fsdp.shard_params_fsdp(params, mesh8)
+    opt = fsdp.init_fsdp_opt_state(shards)
+    ids = jnp.zeros((8, 16), jnp.int32)
+
+    def placement(reshard):
+        step = fsdp.make_fsdp_train_step(shards, cfg, mesh8, donate=False,
+                                         reshard_after_forward=reshard)
+        txt = step.lower(shards, opt, (ids, ids)).compile().as_text()
+        return collective_placement(txt)
+
+    z3 = placement(True)
+    z2 = placement(False)
+    # 9 stacked layer leaves gather in-loop under reshard; none without
+    assert z3["all-gather"]["in_loop_body"] >= 9, z3
+    assert z2["all-gather"]["in_loop_body"] == 0, z2
+    assert z2["all-gather"]["hoisted"] >= 11, z2
+    # the backward reduce-scatters follow the same placement
+    assert z3["reduce-scatter"]["in_loop_body"] >= 9, z3
+    assert z2["reduce-scatter"]["in_loop_body"] == 0, z2
