@@ -100,6 +100,16 @@ KNOB_MATRIX = [
     # that OOM'd at 18.2 GB planned now fits.  Straight-through
     # backward; forward carries per-row int8 noise (the same noise the
     # int8 matmuls already inject).
+    # MEASURED OUTCOME (r4, v5e-16GB): the wall is crossed but the
+    # ceiling stands.  save_dots_q8×int8 FITS at b2 = 115.2 TFLOPS
+    # (where save_dots×int8 OOM'd), yet loses to plain int8_bwd full
+    # remat (122.0 at b2): eliminating the matmul recompute is only
+    # worth ~6% here (save_dots 110.1 vs full 103.6 bf16) and the
+    # per-dot quantize/dequant round-trip costs more than that; the
+    # b4/b8 q8 crossings still OOM (halving dots bytes isn't enough).
+    # The knob-space ceiling therefore remains int8_bwd at large batch
+    # ≈ 125.8 TFLOPS/dev — now an EXHAUSTIVELY measured ceiling, not an
+    # unattacked wall.
     ("explicit_save_dots_q8", {"remat_policy": "save_dots_q8"},
      {"reshard_after_forward": True}, 1),
     ("explicit_save_dots_q8_int8", {"remat_policy": "save_dots_q8",

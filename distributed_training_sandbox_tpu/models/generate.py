@@ -119,21 +119,29 @@ def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope, li,
     cv = lax.dynamic_update_slice(cache.v[li], v, (0, start, 0, 0))
     new_cache = (ck, cv)
 
-    # attention over the cache: visible = pos_kv <= pos_q (absolute)
+    # attention over the cache: visible = pos_kv <= pos_q (absolute).
+    # GQA reads the cache DIRECTLY — grouping the q heads per kv head
+    # instead of jnp.repeat'ing (and fp32-upcasting) the cache, which
+    # materialized nq/nkv × the KV bytes per step and made long-prompt
+    # decode cache-copy-bound (measured 0.17 of roofline at prompt 2048
+    # before this).  Scores accumulate in fp32 via
+    # preferred_element_type; probs drop to the compute dtype for PV,
+    # mirroring the training attention's numerics (_attention_xla).
     S_max = ck.shape[1]
     rep = nq // nkv
-    kf = jnp.repeat(ck, rep, axis=2) if rep != 1 else ck
-    vf = jnp.repeat(cv, rep, axis=2) if rep != 1 else cv
-    scores = jnp.einsum("bqnh,bknh->bnqk", q.astype(jnp.float32),
-                        kf.astype(jnp.float32)) / math.sqrt(hd)
+    qg = q.reshape(B, S, nkv, rep, hd)
+    scores = jnp.einsum(
+        "bsgrh,bkgh->bgrsk", qg, ck,
+        preferred_element_type=jnp.float32) / math.sqrt(hd)
     pos_q = start + jnp.arange(S)
     pos_kv = jnp.arange(S_max)
-    vis = pos_kv[None, :] <= pos_q[:, None]
-    scores = jnp.where(vis[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bnqk,bknh->bqnh", probs,
-                      vf.astype(jnp.float32)).astype(x.dtype)
-    attn_out = dense(attn.reshape(B, S, nq * hd), layer["wo"])
+    vis = pos_kv[None, :] <= pos_q[:, None]          # (S, S_max)
+    scores = jnp.where(vis[None, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    attn = jnp.einsum("bgrsk,bkgh->bsgrh", probs, cv,
+                      preferred_element_type=jnp.float32)
+    attn = attn.astype(x.dtype).reshape(B, S, nq * hd)
+    attn_out = dense(attn, layer["wo"])
     if tp_axis:
         from ..ops import collectives as C
         attn_out = C.all_reduce(attn_out, tp_axis)
@@ -233,8 +241,19 @@ def generate(params, prompt_ids, cfg: T.TransformerConfig, *,
                          "rng=jax.random.PRNGKey(...) explicitly")
     if rng is None:
         rng = jax.random.PRNGKey(0)   # unused by greedy picks
-    return _generate_core(params, prompt_ids, rng, cfg, max_new_tokens,
-                          temperature)
+    return _generate_core(params, prompt_ids, rng, _decode_cfg(cfg),
+                          max_new_tokens, temperature)
+
+
+def _decode_cfg(cfg: T.TransformerConfig) -> T.TransformerConfig:
+    """Decode never checkpoints, so remat knobs must not leak in: a
+    save_dots_q8-trained config would otherwise pay the int8 save
+    round-trip (noise + cost, zero memory benefit) on every decode
+    projection."""
+    if cfg.remat:
+        import dataclasses
+        return dataclasses.replace(cfg, remat=False)
+    return cfg
 
 
 def make_tp_generate(cfg: T.TransformerConfig, mesh, *, axis: str = "tp",
@@ -252,6 +271,7 @@ def make_tp_generate(cfg: T.TransformerConfig, mesh, *, axis: str = "tp",
     from ..parallel.tensor import check_tp_divisibility, tp_specs
 
     check_tp_divisibility(cfg, int(mesh.shape[axis]))
+    cfg = _decode_cfg(cfg)
 
     def core(params, prompt_ids, rng):
         return _generate_core(params, prompt_ids, rng, cfg,

@@ -167,6 +167,13 @@ SMOLLM3_3B = TransformerConfig()
 SMOLLM3_3B_L8 = TransformerConfig(
     num_hidden_layers=8, attention_impl="flash", loss_vocab_chunk=16_032)
 
+# Switch-MoE flagship: the 3B-L8 geometry with its MLP split into 8
+# experts of ffn 2752 (dense MLP FLOPs 4-ways active) — the bench/MoE-A/B
+# configuration as a named constant (scripts/moe_bench.py BASE).
+SMOLLM3_3B_L8_MOE = TransformerConfig(
+    num_hidden_layers=8, attention_impl="flash", loss_vocab_chunk=16_032,
+    n_experts=8, moe_ffn=2752, moe_dispatch="grouped")
+
 # Qwen3-4B-class geometry — the reference fp8 benchmark's default model
 # family (``fp8/modal_app.py:40``: Qwen/Qwen3-4B): hidden 2560, 9728
 # FFN, 32/8 GQA heads at head_dim 128, 151936 vocab, rope 1M.  Geometry
@@ -351,12 +358,14 @@ def _dense(cfg: TransformerConfig):
     bf16; int8 (XLA fwd); int8_pallas (fused quantize-matmul kernel fwd);
     *_bwd variants additionally run both backward matmuls at int8.
 
-    Under ``remat_policy="save_dots_q8"`` every output makes the int8
-    save round-trip (``quant.quantized_residual``) so the remat policy
-    keeps the int8 pair instead of the bf16 tensor."""
+    Under ``remat_policy="save_dots_q8"`` (and only with remat ON —
+    without ``jax.checkpoint`` nothing is saved, so the round-trip
+    would be pure noise+cost) every output makes the int8 save
+    round-trip (``quant.quantized_residual``) so the remat policy keeps
+    the int8 pair instead of the bf16 tensor."""
     from ..ops.quant import quantized_residual, resolve_quantized_dense
     base = resolve_quantized_dense(cfg.matmul_precision)
-    if cfg.remat_policy == "save_dots_q8":
+    if cfg.remat and cfg.remat_policy == "save_dots_q8":
         return lambda a, w: quantized_residual(base(a, w))
     return base
 

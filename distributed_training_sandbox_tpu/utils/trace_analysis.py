@@ -167,6 +167,9 @@ def collective_placement(txt: str) -> dict:
                          "hoisted": total - in_loop}
     # opcode-anchored: a raw substring count would also hit the
     # instruction's own %name and the operand reference in the paired
-    # -done line (~3 hits per actual pair)
-    out["async_pairs"] = txt.count("all-gather-start(")
+    # -done line (~3 hits per actual pair).  Counted for EVERY
+    # collective kind — async reduce-scatter/all-reduce pairs are
+    # overlap evidence too.
+    out["async_pairs"] = sum(txt.count(f"{kind}-start(")
+                             for kind in HLO_COLLECTIVES)
     return out

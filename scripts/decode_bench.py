@@ -95,6 +95,9 @@ def main(argv=None):
     p.add_argument("--new", type=int, default=128)
     p.add_argument("--sweep", action="store_true")
     p.add_argument("--out-dir", default="decode_results")
+    p.add_argument("--out-file", default=None,
+                   help="output filename (default decode_<platform>.json"
+                        " — pass one per model to avoid clobbering)")
     args = p.parse_args(argv)
 
     import jax
@@ -123,7 +126,7 @@ def main(argv=None):
     rows = []
     out_dir = Path(args.out_dir)
     out_dir.mkdir(exist_ok=True)
-    path = out_dir / f"decode_{platform}.json"
+    path = out_dir / (args.out_file or f"decode_{platform}.json")
 
     if args.sweep:
         # grouped by precision so the lazy param cache rebuilds once;

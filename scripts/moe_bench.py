@@ -25,7 +25,13 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import bench  # noqa: E402
 
-BASE = {"n_experts": 8, "moe_ffn": 2752, "num_hidden_layers": 8}
+# Derived from the ONE named MoE flagship constant so decode/A-B/bench
+# all measure the same geometry (models.transformer.SMOLLM3_3B_L8_MOE).
+from distributed_training_sandbox_tpu.models import transformer as _T  # noqa: E402
+
+BASE = {"n_experts": _T.SMOLLM3_3B_L8_MOE.n_experts,
+        "moe_ffn": _T.SMOLLM3_3B_L8_MOE.moe_ffn,
+        "num_hidden_layers": _T.SMOLLM3_3B_L8_MOE.num_hidden_layers}
 GRID = [
     # the r3 default: grouped one-hot dispatch, capacity-factor sweep
     ({"moe_dispatch": "grouped"}, 4),

@@ -39,7 +39,12 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-BASE_MOE = {"n_experts": 8, "moe_ffn": 2752, "moe_dispatch": "grouped"}
+def _base_moe() -> dict:
+    # the ONE named MoE flagship geometry (shared with moe_bench/decode)
+    from distributed_training_sandbox_tpu.models.transformer import (
+        SMOLLM3_3B_L8_MOE as M)
+    return {"n_experts": M.n_experts, "moe_ffn": M.moe_ffn,
+            "moe_dispatch": M.moe_dispatch}
 
 
 @contextlib.contextmanager
@@ -186,6 +191,17 @@ def main(argv=None):
     p.add_argument("--peak-lr", type=float, default=3e-4)
     p.add_argument("--warmup-steps", type=int, default=30)
     p.add_argument("--eval-every", type=int, default=20)
+    p.add_argument("--aux-weight", type=float, default=0.01,
+                   help="MoE load-balance weight for the MoE legs — the "
+                        "first A/B (default 0.01) measured the router "
+                        "COLLAPSING (drop rate 0.10→0.65 as it trains); "
+                        "re-run with 0.1 to test whether a stronger "
+                        "balance loss rescues the throughput win")
+    p.add_argument("--tag", default="",
+                   help="suffix for the output json/plot (e.g. aux01)")
+    p.add_argument("--skip-dense", action="store_true",
+                   help="reuse an earlier run's dense leg (the dense "
+                        "model has no aux knob)")
     p.add_argument("--cpu-devices", type=int, default=0)
     p.add_argument("--tiny", action="store_true",
                    help="CI shape: tiny geometry, short budget")
@@ -193,6 +209,10 @@ def main(argv=None):
     p.add_argument("--plot", default="plots/moe_quality_ab.png")
     args = p.parse_args(argv)
 
+    if args.skip_dense and not args.tag:
+        raise SystemExit("--skip-dense needs --tag: without one the "
+                         "output would overwrite the very file the "
+                         "dense baseline is read from")
     if args.cpu_devices:
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(args.cpu_devices)
@@ -202,12 +222,12 @@ def main(argv=None):
     from distributed_training_sandbox_tpu.models import transformer as T
 
     seq, bs = args.sequence_length, args.batch_size
-    moe = dict(BASE_MOE)
+    moe = _base_moe()
     tiny_over = {}
     if args.tiny:
         seq, bs = 128, 4
         tiny_over = dataclasses.asdict(T.TINY_LM)
-        moe = {**BASE_MOE, "n_experts": 4, "moe_ffn": 40}
+        moe = {**_base_moe(), "n_experts": 4, "moe_ffn": 40}
 
     vocab = (tiny_over or dataclasses.asdict(T.SMOLLM3_3B_L8))["vocab_size"]
     # ~400 steps of fresh windows, looped if a leg outruns them; +8 eval
@@ -221,17 +241,27 @@ def main(argv=None):
     def with_tiny(over):
         return {**tiny_over, **over} if args.tiny else over
 
+    aw = args.aux_weight
+    aux_tag = "" if aw == 0.01 else f"_aux{aw:g}"
+    leg_list = [] if args.skip_dense else [("dense", {})]
+    leg_list += [
+        (f"moe_cf2.0{aux_tag}", {**moe, "moe_capacity_factor": 2.0,
+                                 "moe_aux_weight": aw}),
+        (f"moe_cf1.0{aux_tag}", {**moe, "moe_capacity_factor": 1.0,
+                                 "moe_aux_weight": aw}),
+    ]
     legs = []
-    for name, over in [
-        ("dense", {}),
-        ("moe_cf2.0", {**moe, "moe_capacity_factor": 2.0}),
-        ("moe_cf1.0", {**moe, "moe_capacity_factor": 1.0}),
-    ]:
+    for name, over in leg_list:
         legs.append(run_leg(name, with_tiny(over), args.seconds, seq, bs,
                             args.peak_lr, args.warmup_steps,
                             args.eval_every, data, eval_batch))
 
-    dense_eval = legs[0]["final_eval_loss"]
+    if args.skip_dense:
+        prior = Path(args.out_dir) / f"quality_ab_{jax.devices()[0].platform}.json"
+        dense_eval = json.loads(prior.read_text())["verdict"]["dense"][
+            "final_eval_loss"] if prior.exists() else float("nan")
+    else:
+        dense_eval = legs[0]["final_eval_loss"]
     out = {
         "platform": jax.devices()[0].platform,
         "seconds_budget": args.seconds,
@@ -249,11 +279,16 @@ def main(argv=None):
     }
     out_dir = Path(args.out_dir)
     out_dir.mkdir(exist_ok=True)
-    path = out_dir / f"quality_ab_{out['platform']}.json"
+    tag = f"_{args.tag}" if args.tag else ""
+    path = out_dir / f"quality_ab_{out['platform']}{tag}.json"
     path.write_text(json.dumps(out))
     print(f"[moe-ab] verdict: {json.dumps(out['verdict'], indent=1)}")
     print(f"[moe-ab] -> {path}")
-    plot(out, Path(args.plot))
+    plot_path = Path(args.plot)
+    if tag:
+        plot_path = plot_path.with_name(
+            plot_path.stem + tag + plot_path.suffix)
+    plot(out, plot_path)
 
 
 if __name__ == "__main__":

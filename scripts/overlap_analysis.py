@@ -62,9 +62,12 @@ def gather_operands_loop_invariant(txt: str) -> bool | None:
             op = m.group(1)
             # operand must be produced by a dynamic-slice / fusion over
             # the loop state (stacked shards) — not by this body's
-            # compute chain (dot etc.)
-            prod = re.search(rf"%?{re.escape(op)}\s*=\s*[^=]*?(\w[\w\-]*)\(",
-                             text)
+            # compute chain (dot etc.).  Left-anchored so a longer
+            # instruction name merely ENDING in the operand string
+            # (e.g. %loop_fusion.1 vs fusion.1) can't match.
+            prod = re.search(
+                rf"(?:^|\s)%?{re.escape(op)}\s*=\s*[^=]*?(\w[\w\-]*)\(",
+                text, re.MULTILINE)
             if prod and prod.group(1) in ("dot", "convolution"):
                 return False
     return found
