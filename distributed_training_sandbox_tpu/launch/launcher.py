@@ -287,8 +287,16 @@ def _run_multiprocess(config: LaunchConfig, cmd: list[str], env: dict,
             f"process per host with auto topology discovery")
     coord = f"127.0.0.1:{_free_port()}"
     base_env = {k: v for k, v in env.items()
-                if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
-                             "JAX_NUM_PROCESSES")}
+                if k not in ("JAX_PLATFORMS", "JAX_NUM_PROCESSES")}
+    # keep user XLA_FLAGS; strip only the host-device-count flag that
+    # would conflict with the per-worker cpu:K spec
+    if "XLA_FLAGS" in base_env:
+        kept = [f for f in base_env["XLA_FLAGS"].split()
+                if not f.startswith("--xla_force_host_platform_device_count")]
+        if kept:
+            base_env["XLA_FLAGS"] = " ".join(kept)
+        else:
+            del base_env["XLA_FLAGS"]
     procs, logs = [], []
     for pid in range(nprocs):
         wenv = {**base_env, "DTS_COORDINATOR": coord,
@@ -303,14 +311,30 @@ def _run_multiprocess(config: LaunchConfig, cmd: list[str], env: dict,
                 if config.timeout else None)
     rc = 0
     try:
-        for pid, p in enumerate(procs):
-            remaining = (max(deadline - _time.monotonic(), 0.1)
-                         if deadline else None)
-            code = p.wait(timeout=remaining)
-            # signal-killed workers return NEGATIVE codes — any nonzero
-            # (either sign) must fail the run, so don't max() with 0
-            if code != 0 and rc == 0:
-                rc = 1
+        # poll ALL workers: if one dies during bring-up the survivors
+        # block in collectives until timeout — kill the group as soon
+        # as any worker exits nonzero instead of waiting it out
+        live = dict(enumerate(procs))
+        while live:
+            if deadline and _time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired(cmd, config.timeout)
+            for pid in list(live):
+                code = live[pid].poll()
+                if code is None:
+                    continue
+                del live[pid]
+                # signal-killed workers return NEGATIVE codes — any
+                # nonzero (either sign) must fail the run
+                if code != 0:
+                    rc = 1
+                    for q in live.values():
+                        q.kill()
+                    for q in live.values():
+                        q.wait()
+                    live.clear()
+                    break
+            if live:
+                _time.sleep(0.1)
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()

@@ -20,4 +20,5 @@ MODEL_REGISTRY = {
     "llama3.1-8b": "LLAMA31_8B",
     "tiny": "TINY_LM",
     "tiny8": "TINY_LM_L8",
+    "corpus-70m": "CORPUS_LM",
 }

@@ -43,22 +43,43 @@ from . import transformer as T
 
 
 class KVCache(NamedTuple):
-    k: jax.Array      # (L, B, S_max, n_kv, hd)
-    v: jax.Array      # (L, B, S_max, n_kv, hd)
+    """Per-layer cache buffers (tuples of L arrays, each
+    (B, S_max, n_kv, hd)) rather than one stacked (L, ...) array: the
+    stacked layout made every decode step pay a dynamic-slice COPY of
+    each layer's cache (indexing ``cache.k[li]`` inside the layer scan)
+    plus a full re-stack into the scan's ys — ~3× the unavoidable
+    cache-read traffic, measured as the r4 long-prompt gap (0.50 of
+    roofline at prompt 2048).  With per-layer buffers the layer loop is
+    unrolled (static layer index), ``dynamic_update_slice`` writes only
+    the new token column in place, and the attention einsum reads the
+    buffer directly.
+
+    ``k_scale``/``v_scale``: per-(batch, position, head) fp32 absmax
+    scales when the cache is stored int8 (``quantized=True``) — half the
+    cache-read bytes, the decode twin of the int8 weight path; None for
+    the bf16 cache."""
+    k: tuple          # L × (B, S_max, n_kv, hd) cfg.dtype or int8
+    v: tuple          # L × (B, S_max, n_kv, hd)
+    k_scale: tuple | None   # L × (B, S_max, n_kv, 1) f32 (int8 only)
+    v_scale: tuple | None
     length: jax.Array  # () int32 — tokens currently cached
 
 
 def init_cache(cfg: T.TransformerConfig, batch: int,
-               max_len: int, tp: int = 1) -> KVCache:
+               max_len: int, tp: int = 1,
+               quantized: bool = False) -> KVCache:
     """``tp`` > 1: the TENSOR-PARALLEL cache — each rank caches only its
     ``n_kv/tp`` local heads (the KV memory and the per-step cache read
     both shrink by tp, the point of TP-sharded decode)."""
     L, nkv, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
                   cfg.resolved_head_dim)
-    shape = (L, batch, max_len, nkv // tp, hd)
-    return KVCache(k=jnp.zeros(shape, cfg.dtype),
-                   v=jnp.zeros(shape, cfg.dtype),
-                   length=jnp.zeros((), jnp.int32))
+    shape = (batch, max_len, nkv // tp, hd)
+    dt = jnp.int8 if quantized else cfg.dtype
+    zeros = lambda: tuple(jnp.zeros(shape, dt) for _ in range(L))
+    scales = lambda: (tuple(jnp.ones(shape[:-1] + (1,), jnp.float32)
+                            for _ in range(L)) if quantized else None)
+    return KVCache(k=zeros(), v=zeros(), k_scale=scales(),
+                   v_scale=scales(), length=jnp.zeros((), jnp.int32))
 
 
 # Projection leaves quantized for decode; stacked (L, K, N) → per-layer
@@ -91,13 +112,26 @@ def quantize_decode_params(params: dict, cfg: T.TransformerConfig) -> dict:
     return out
 
 
-def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope, li,
-                       cache: KVCache, start, tp_axis=None):
-    """One decoder layer that READS/WRITES the cache: the training
-    layer's SHARED projection/MLP helpers (``transformer._qkv_proj`` /
-    ``_mlp_block`` — one implementation, no drift) with attention run
-    against [0, start + S) of the cache instead of the local chunk.
-    x: (B, S, H) with S = prefill length or 1.
+def _quant_kv(t):
+    """(B, S, n_kv, hd) bf16 → (int8, (B, S, n_kv, 1) f32 scales):
+    per-(batch, position, head) row quantization over hd — the shared
+    symmetric absmax quantizer (``ops.quant.quantize_int8``)."""
+    from ..ops.quant import quantize_int8
+    return quantize_int8(t, axis=-1)
+
+
+def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope,
+                       ck, cv, ck_s, cv_s, start, tp_axis=None):
+    """One decoder layer that READS/WRITES its cache buffers: the
+    training layer's SHARED projection/MLP helpers
+    (``transformer._qkv_proj`` / ``_mlp_block`` — one implementation, no
+    drift) with attention run against [0, start + S) of the cache
+    instead of the local chunk.  x: (B, S, H) with S = prefill length
+    or 1.  ``ck``/``cv`` are THIS layer's (B, S_max, n_kv, hd) buffers;
+    ``ck_s``/``cv_s`` their int8 row scales or None — updates are
+    single in-place ``dynamic_update_slice`` writes of the new token
+    column (the stacked-(L, ...) layout's per-step slice copy + restack
+    was the r4 long-prompt decode gap).
 
     ``tp_axis``: Megatron tensor-parallel decode (shard_map only) —
     ``layer`` holds this rank's head/intermediate shards
@@ -115,9 +149,17 @@ def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope, li,
     q, k, v = T._qkv_proj(r, layer, cfg=cfg, cos=cos, sin=sin,
                           use_rope=use_rope, tp=tp)
 
-    ck = lax.dynamic_update_slice(cache.k[li], k, (0, start, 0, 0))
-    cv = lax.dynamic_update_slice(cache.v[li], v, (0, start, 0, 0))
-    new_cache = (ck, cv)
+    quantized = ck.dtype == jnp.int8
+    if quantized:
+        kq, ks_new = _quant_kv(k)
+        vq, vs_new = _quant_kv(v)
+        ck = lax.dynamic_update_slice(ck, kq, (0, start, 0, 0))
+        cv = lax.dynamic_update_slice(cv, vq, (0, start, 0, 0))
+        ck_s = lax.dynamic_update_slice(ck_s, ks_new, (0, start, 0, 0))
+        cv_s = lax.dynamic_update_slice(cv_s, vs_new, (0, start, 0, 0))
+    else:
+        ck = lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
 
     # attention over the cache: visible = pos_kv <= pos_q (absolute).
     # GQA reads the cache DIRECTLY — grouping the q heads per kv head
@@ -127,19 +169,39 @@ def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope, li,
     # before this).  Scores accumulate in fp32 via
     # preferred_element_type; probs drop to the compute dtype for PV,
     # mirroring the training attention's numerics (_attention_xla).
+    # int8 cache: scores contract the int8 codes directly (fp32
+    # accumulation) and the per-row K scale — constant over hd, the
+    # contracted dim — multiplies the score afterwards, so the HBM read
+    # really is int8; the V side folds its scale into the fp32 PV
+    # accumulation the same way.
     S_max = ck.shape[1]
     rep = nq // nkv
     qg = q.reshape(B, S, nkv, rep, hd)
-    scores = jnp.einsum(
-        "bsgrh,bkgh->bgrsk", qg, ck,
-        preferred_element_type=jnp.float32) / math.sqrt(hd)
+    if quantized:
+        scores = jnp.einsum(
+            "bsgrh,bkgh->bgrsk", qg.astype(jnp.float32),
+            ck.astype(jnp.float32),
+            preferred_element_type=jnp.float32) / math.sqrt(hd)
+        # fold the K row scales over the cache-position axis k
+        scores = scores * ck_s[..., 0].transpose(0, 2, 1)[:, :, None, None, :]
+    else:
+        scores = jnp.einsum(
+            "bsgrh,bkgh->bgrsk", qg, ck,
+            preferred_element_type=jnp.float32) / math.sqrt(hd)
     pos_q = start + jnp.arange(S)
     pos_kv = jnp.arange(S_max)
     vis = pos_kv[None, :] <= pos_q[:, None]          # (S, S_max)
     scores = jnp.where(vis[None, None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    attn = jnp.einsum("bgrsk,bkgh->bsgrh", probs, cv,
-                      preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if quantized:
+        # weight probs by the V row scales, contract int8 codes in fp32
+        pv = probs * cv_s[..., 0].transpose(0, 2, 1)[:, :, None, None, :]
+        attn = jnp.einsum("bgrsk,bkgh->bsgrh", pv,
+                          cv.astype(jnp.float32),
+                          preferred_element_type=jnp.float32)
+    else:
+        attn = jnp.einsum("bgrsk,bkgh->bsgrh", probs.astype(x.dtype), cv,
+                          preferred_element_type=jnp.float32)
     attn = attn.astype(x.dtype).reshape(B, S, nq * hd)
     attn_out = dense(attn, layer["wo"])
     if tp_axis:
@@ -151,7 +213,7 @@ def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope, li,
     mlp, _aux = T._mlp_block(r, layer, cfg=cfg)
     if tp_axis:
         mlp = C.all_reduce(mlp, tp_axis)
-    return x + mlp, new_cache
+    return x + mlp, (ck, cv, ck_s, cv_s)
 
 
 def _forward_cached(params, ids, cfg, cache: KVCache, start,
@@ -160,22 +222,37 @@ def _forward_cached(params, ids, cfg, cache: KVCache, start,
     refreshing the cache; ``start`` = absolute position of ids[:, 0].
     Only the LAST position's logits are computed — decoding never needs
     the rest, and a full (B, S, vocab) fp32 prefill buffer would be the
-    exact memory spike the streamed training loss exists to avoid."""
+    exact memory spike the streamed training loss exists to avoid.
+
+    The layer loop is UNROLLED (static layer index into the per-layer
+    cache buffers): each layer's params are sliced statically from the
+    stacked (L, ...) leaves and its cache update is one in-place
+    ``dynamic_update_slice`` — no per-step dynamic-slice copy, no
+    restack.  Decode-depth models (L ≤ ~36) compile fine unrolled; the
+    training path keeps its ``lax.scan``."""
     B, S = ids.shape
     x = params["embed"].astype(cfg.dtype)[ids]
     cos, sin = T._rope_tables(S, cfg.resolved_head_dim, cfg.rope_theta,
                               start)
-    flags = T._rope_flags(cfg)
+    # host-side: the unrolled loop needs CONCRETE per-layer flags
+    # (T._rope_flags stages jnp ops, which are tracers under this jit)
+    flags = [(li + 1) % cfg.nope_interval != 0 if cfg.nope_interval
+             else True for li in range(cfg.num_hidden_layers)]
 
-    def body(x, scanned):
-        li, layer, use_rope = scanned
-        x, (ck, cv) = _cached_layer_body(
-            x, layer, cfg=cfg, cos=cos, sin=sin, use_rope=use_rope,
-            li=li, cache=cache, start=start, tp_axis=tp_axis)
-        return x, (ck, cv)
-
-    idx = jnp.arange(cfg.num_hidden_layers)
-    x, (ks, vs) = lax.scan(body, x, (idx, params["layers"], flags))
+    ks, vs = list(cache.k), list(cache.v)
+    kss = list(cache.k_scale) if cache.k_scale is not None else None
+    vss = list(cache.v_scale) if cache.v_scale is not None else None
+    for li in range(cfg.num_hidden_layers):
+        layer = jax.tree.map(lambda p: p[li], params["layers"])
+        x, (ks[li], vs[li], ksc, vsc) = _cached_layer_body(
+            x, layer, cfg=cfg, cos=cos, sin=sin,
+            use_rope=bool(flags[li]),
+            ck=ks[li], cv=vs[li],
+            ck_s=kss[li] if kss is not None else None,
+            cv_s=vss[li] if vss is not None else None,
+            start=start, tp_axis=tp_axis)
+        if kss is not None:
+            kss[li], vss[li] = ksc, vsc
     x = T.rms_norm(x[:, -1:], params["final_norm"], cfg.rms_norm_eps)
     uq = params.get("unembed_q")
     if uq is not None:       # int8 decode: the (H, vocab) read halves
@@ -183,17 +260,20 @@ def _forward_cached(params, ids, cfg, cache: KVCache, start,
         logits = prequantized_dense(x, uq)[:, 0]
     else:
         logits = (x @ T._output_embedding(params, cfg).T)[:, 0]
-    new = KVCache(k=ks, v=vs, length=start + S)
+    new = KVCache(k=tuple(ks), v=tuple(vs),
+                  k_scale=tuple(kss) if kss is not None else None,
+                  v_scale=tuple(vss) if vss is not None else None,
+                  length=start + S)
     return logits.astype(jnp.float32), new
 
 
 def _generate_core(params, prompt_ids, rng, cfg: T.TransformerConfig,
                    max_new_tokens: int, temperature: float,
-                   tp_axis=None):
+                   tp_axis=None, kv_quant: bool = False):
     B, S0 = prompt_ids.shape
     S_max = S0 + max_new_tokens
     tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
-    cache = init_cache(cfg, B, S_max, tp=tp)
+    cache = init_cache(cfg, B, S_max, tp=tp, quantized=kv_quant)
     logits, cache = _forward_cached(params, prompt_ids, cfg, cache, 0,
                                     tp_axis=tp_axis)
 
@@ -224,17 +304,19 @@ def _generate_core(params, prompt_ids, rng, cfg: T.TransformerConfig,
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
-                                   "temperature"))
+                                   "temperature", "kv_quant"))
 def generate(params, prompt_ids, cfg: T.TransformerConfig, *,
              max_new_tokens: int = 32, temperature: float = 0.0,
-             rng: jax.Array | None = None):
+             rng: jax.Array | None = None, kv_quant: bool = False):
     """Decode ``max_new_tokens`` after ``prompt_ids`` (B, S_prompt).
 
     temperature 0 = greedy argmax; > 0 = categorical sampling — ``rng``
     is then REQUIRED (a silent default key would return identical
-    "samples" on every call).  Returns (B, max_new_tokens) int32.  One
-    prefill forward + one scanned decode loop — two compiled programs
-    total, static shapes throughout.
+    "samples" on every call).  ``kv_quant`` stores the KV cache int8
+    with per-row scales — half the cache-read bytes per step, the
+    long-prompt lever.  Returns (B, max_new_tokens) int32.  One prefill
+    forward + one scanned decode loop — two compiled programs total,
+    static shapes throughout.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 samples stochastically: pass "
@@ -242,7 +324,8 @@ def generate(params, prompt_ids, cfg: T.TransformerConfig, *,
     if rng is None:
         rng = jax.random.PRNGKey(0)   # unused by greedy picks
     return _generate_core(params, prompt_ids, rng, _decode_cfg(cfg),
-                          max_new_tokens, temperature)
+                          max_new_tokens, temperature,
+                          kv_quant=kv_quant)
 
 
 def _decode_cfg(cfg: T.TransformerConfig) -> T.TransformerConfig:

@@ -114,6 +114,13 @@ class TransformerConfig:
     # experts per token: 1 = Switch, 2+ = GShard top-k (normalized gates,
     # active FLOPs ×k; requires the grouped dispatch).
     moe_top_k: int = 1
+    # Router-health knobs (ST-MoE): z-loss weight on mean
+    # logsumexp(router logits)² — keeps logits small so the balance aux
+    # keeps gradient signal; and a router LR multiplier (<1 slows the
+    # router relative to the experts, the standard fix when the router
+    # collapses faster than experts can differentiate).
+    moe_router_z_weight: float = 0.0
+    moe_router_lr_mult: float = 1.0
     ep_axis: str | None = None
 
     def __post_init__(self):
@@ -136,6 +143,10 @@ class TransformerConfig:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} requires moe_dispatch="
                 f"'grouped' (got {self.moe_dispatch!r})")
+        if self.moe_router_z_weight and not self.moe_aux_weight:
+            raise ValueError(
+                "moe_router_z_weight rides the aux-loss channel scaled "
+                "by moe_aux_weight — set moe_aux_weight > 0 too")
 
     @property
     def resolved_head_dim(self) -> int:
@@ -216,6 +227,17 @@ TINY_LM = TransformerConfig(
     vocab_size=512, hidden_size=64, intermediate_size=160,
     num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
     rope_theta=10_000.0, dtype=jnp.float32, remat=False)
+
+# Real-text fixture geometry (~70 M params): vocab matches the committed
+# corpus tokenizer (data/corpus/tokenizer.json, vocab 8192) so the
+# offline real-text path trains it directly — the substrate for the MoE
+# quality A/B and the corpus flagship runs (reference trains on real
+# TinyStories text, fsdp/utils.py:29-91).
+CORPUS_LM = TransformerConfig(
+    vocab_size=8192, hidden_size=768, intermediate_size=2048,
+    num_hidden_layers=8, num_attention_heads=12, num_key_value_heads=4,
+    head_dim=64, rope_theta=10_000.0, nope_interval=0,
+    attention_impl="flash")
 # 8-layer sibling: depth experiments (4-stage / interleaved pipelines
 # need more layers than TINY_LM's 4).
 TINY_LM_L8 = replace(TINY_LM, num_hidden_layers=8)
@@ -401,7 +423,11 @@ def _mlp_block(r, layer, *, cfg: TransformerConfig):
                            dispatch=cfg.moe_dispatch,
                            group_size=cfg.moe_group_size,
                            top_k=cfg.moe_top_k,
-                           matmul_precision=cfg.matmul_precision)
+                           matmul_precision=cfg.matmul_precision,
+                           router_z_ratio=(cfg.moe_router_z_weight
+                                           / cfg.moe_aux_weight
+                                           if cfg.moe_router_z_weight
+                                           else 0.0))
     else:
         dense = _dense(cfg)
         mlp = dense(jax.nn.silu(dense(r, layer["w_gate"]))

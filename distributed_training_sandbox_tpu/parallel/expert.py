@@ -134,10 +134,21 @@ def _route_topk(x2d, w_router, k: int):
     return gates, experts, probs
 
 
+def router_z_loss(x2d, w_router):
+    """ST-MoE router z-loss: mean over tokens of logsumexp(logits)² —
+    pulls router logits toward zero so the softmax stays in its
+    responsive range (a collapsed router rides saturated logits where
+    the balance aux gradient vanishes).  Recomputes the (N, E) router
+    matmul — negligible next to the expert MLPs — so callers need no
+    logits plumbing."""
+    logits = (x2d @ w_router).astype(jnp.float32)
+    return jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+
+
 def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
             capacity_factor: float = 2.0, dispatch: str = "grouped",
             group_size: int = 128, top_k: int = 1,
-            matmul_precision: str = "bf16"):
+            matmul_precision: str = "bf16", router_z_ratio: float = 0.0):
     """The switch-MoE MLP on local tokens ``x`` (B, S, H) →
     ``(y, aux_loss)``.  ``w_gate/w_up/w_down`` hold this device's
     ``E_local`` experts on dim 0; ``axis=None`` means no expert
@@ -312,18 +323,28 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
             frac = C.all_reduce(frac, axis, mean=True)
             mean_p = C.all_reduce(mean_p, axis, mean=True)
         aux = E * jnp.sum(frac * mean_p)
+        if router_z_ratio:
+            # the z term rides the SAME aux channel (callers multiply by
+            # the balance weight), pre-divided so the configured z weight
+            # lands exactly: ratio = z_weight / aux_weight
+            z = router_z_loss(x2d, w_router)
+            if axis:
+                z = C.all_reduce(z, axis, mean=True)
+            aux = aux + router_z_ratio * z
     return y2d.reshape(B, S, H).astype(x.dtype), aux
 
 
 def moe_layer(params: MoEParams, x, axis: str = "ep", *,
               capacity_factor: float = 2.0, dispatch: str = "grouped",
-              group_size: int = 128, top_k: int = 1):
+              group_size: int = 128, top_k: int = 1,
+              router_z_ratio: float = 0.0):
     """Apply the expert-parallel MoE MLP to local tokens ``x`` (B, S, H)
     (shard_map only).  Returns (y, aux_loss)."""
     return moe_mlp(x, params.w_router, params.w_gate, params.w_up,
                    params.w_down, axis=axis,
                    capacity_factor=capacity_factor, dispatch=dispatch,
-                   group_size=group_size, top_k=top_k)
+                   group_size=group_size, top_k=top_k,
+                   router_z_ratio=router_z_ratio)
 
 
 def moe_reference(params: MoEParams, x, *, capacity_factor: float = 2.0):

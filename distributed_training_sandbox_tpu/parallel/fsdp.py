@@ -90,6 +90,50 @@ def shard_params_fsdp(params, mesh: Mesh, axis: str = "dp"):
         params, specs)
 
 
+def _q8_scale_spec(spec: P, ndim: int) -> P:
+    """The Q8 scale leaf's spec: the param's spec with its LAST dim
+    unsharded (the scale's last dim is 1)."""
+    entries = list(spec) + [None] * (ndim - len(spec))
+    entries[ndim - 1] = None
+    return P(*entries)
+
+
+def q8_state_specs(params_sharded, specs):
+    """PartitionSpec tree matching ``optim8.adam8_init``'s state: Q8
+    leaves for ndim ≥ 2 params, plain specs for 1-D ones."""
+    from .optim8 import Q8
+
+    def leaf(p, s):
+        if p.ndim < 2:
+            return s
+        return Q8(q=s, scale=_q8_scale_spec(s, p.ndim))
+
+    return _spec_map(leaf, params_sharded, specs)
+
+
+def init_fsdp_opt_state8(params_sharded, axis: str = "dp"):
+    """int8-at-rest Adam moments (``parallel.optim8``) sharded like the
+    params — cuts the largest resident block (mu/nu, 3.31 GB of the
+    flagship's 4.96 GB at rest, EXPERIMENTS.md) to ~half.  ``axis``
+    must match the FSDP axis the params were sharded over."""
+    from . import optim8
+
+    state = optim8.adam8_init(params_sharded)
+    specs = fsdp_specs(params_sharded, axis)
+    sspecs = q8_state_specs(params_sharded, specs)
+    leaf = jax.tree.leaves(params_sharded)[0]
+    if not isinstance(getattr(leaf, "sharding", None), NamedSharding):
+        return state
+    mesh = leaf.sharding.mesh
+    put = lambda x, s: jax.device_put(x, NamedSharding(mesh, s))
+    placed = jax.tree.map(
+        lambda x, s: put(x, s), (state.mu, state.nu), (sspecs, sspecs),
+        is_leaf=lambda x: isinstance(x, P))
+    return optim.AdamState(
+        mu=placed[0], nu=placed[1],
+        count=jax.device_put(state.count, NamedSharding(mesh, P())))
+
+
 def init_fsdp_opt_state(params_sharded, state_dtype=None):
     """Adam state with the same sharding as the param shards it tracks —
     optimizer-after-sharding (reference ``train_fsdp.py:96-97``).  The
@@ -148,6 +192,7 @@ def make_fsdp_train_step(
     eps: float = 1e-8,
     donate: bool = True,
     loss_fn: Callable | None = None,
+    state_precision: str = "full",
 ):
     """Jitted explicit-FSDP step:
     ``(param_shards, opt_state, batch) -> (param_shards, opt_state, loss)``.
@@ -165,12 +210,26 @@ def make_fsdp_train_step(
     ``lr_schedule``: optional ``count -> lr`` (e.g.
     ``optim.warmup_cosine_schedule``) evaluated on the optimizer step
     counter inside the jitted step; overrides the constant ``lr``.
+
+    ``state_precision``: "full" (moments in the params' dtype,
+    ``init_fsdp_opt_state``) or "int8" (``init_fsdp_opt_state8`` /
+    ``optim8.adam8_update`` — int8-at-rest moments, ~half the largest
+    resident block; pass the matching opt state).
     """
     ws = int(mesh.shape[axis])
     if sp_axis is not None:
         cfg = dataclasses.replace(cfg, attention_impl="ring",
                                   sp_axis=sp_axis)
     base_loss = loss_fn or T.lm_loss
+    # per-leaf LR multipliers: the MoE router trains slower when
+    # cfg.moe_router_lr_mult < 1 (router-collapse mitigation, ST-MoE)
+    lr_mults = None
+    if getattr(cfg, "moe_router_lr_mult", 1.0) != 1.0:
+        lr_mults = jax.tree_util.tree_map_with_path(
+            lambda path, _leaf: (cfg.moe_router_lr_mult
+                                 if any(getattr(k, "key", None) == "w_router"
+                                        for k in path) else 1.0),
+            params_sharded)
     specs = fsdp_specs(params_sharded, axis)
     check_divisibility(params_sharded, specs, mesh)
     layer_specs = specs["layers"]
@@ -225,12 +284,22 @@ def make_fsdp_train_step(
                 grad_shards)
         with scope("opt_step"):
             lr_t = lr_schedule(opt_state.count) if lr_schedule else lr
-            shards, opt_state = optim.adam_update(
-                grad_shards, opt_state, shards,
-                lr=lr_t, b1=b1, b2=b2, eps=eps)
+            if state_precision == "int8":
+                from . import optim8
+                shards, opt_state = optim8.adam8_update(
+                    grad_shards, opt_state, shards,
+                    lr=lr_t, b1=b1, b2=b2, eps=eps, lr_mults=lr_mults)
+            else:
+                shards, opt_state = optim.adam_update(
+                    grad_shards, opt_state, shards,
+                    lr=lr_t, b1=b1, b2=b2, eps=eps, lr_mults=lr_mults)
         return shards, opt_state, loss
 
-    state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
+    if state_precision == "int8":
+        sspec = q8_state_specs(params_sharded, specs)
+        state_specs = optim.AdamState(mu=sspec, nu=sspec, count=P())
+    else:
+        state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
     batch_spec = P(axis) if sp_axis is None else P(axis, sp_axis)
     sharded = C.smap(step, mesh,
                      in_specs=(specs, state_specs, batch_spec),

@@ -30,7 +30,12 @@ def adam_init(params) -> AdamState:
 
 
 def adam_update(grads, state: AdamState, params, *, lr=1e-3, b1=0.9, b2=0.999,
-                eps=1e-8):
+                eps=1e-8, lr_mults=None):
+    """``lr_mults``: optional pytree of scalars matching ``params`` —
+    per-leaf LR multipliers (e.g. a slow MoE router,
+    ``TransformerConfig.moe_router_lr_mult``).  Grad scaling can NOT do
+    this job: Adam divides by √nu, so a scaled gradient nearly cancels;
+    only the step itself can be scaled."""
     count = state.count + 1
     mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
     nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, grads)
@@ -38,15 +43,18 @@ def adam_update(grads, state: AdamState, params, *, lr=1e-3, b1=0.9, b2=0.999,
     bc1 = 1 - b1 ** c
     bc2 = 1 - b2 ** c
 
-    def upd(p, m, v):
+    def upd(p, m, v, s=1.0):
         # fp32 math, cast back: keeps bf16 params bf16 (a silent f32
         # promotion here changes the train-step's input types and forces
         # a retrace-and-fail on step 2).
-        step = lr * (m.astype(jnp.float32) / bc1) / (
+        step = (lr * s) * (m.astype(jnp.float32) / bc1) / (
             jnp.sqrt(v.astype(jnp.float32) / bc2) + eps)
         return (p.astype(jnp.float32) - step).astype(p.dtype)
 
-    new_params = jax.tree.map(upd, params, mu, nu)
+    if lr_mults is None:
+        new_params = jax.tree.map(upd, params, mu, nu)
+    else:
+        new_params = jax.tree.map(upd, params, mu, nu, lr_mults)
     return new_params, AdamState(mu=mu, nu=nu, count=count)
 
 
@@ -71,6 +79,21 @@ def warmup_cosine_schedule(peak_lr: float, warmup_steps: int,
         return jnp.where(c < warmup_steps, warm, cos)
 
     return sched
+
+
+from functools import partial
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def adam_step_donated(grads, state: AdamState, params, lr):
+    """``adam_update`` as ONE compiled program with grads/state/params
+    donated: XLA aliases the outputs onto the input buffers, so the
+    update runs in place instead of materializing a second copy of the
+    whole optimizer state — the difference between fitting and OOM for
+    a billion-param single-chip pipeline stage set (the eager tree.map
+    path transiently holds old+new mu/nu/params simultaneously).
+    ``lr`` is traced, so a warmup schedule doesn't recompile."""
+    return adam_update(grads, state, params, lr=lr)
 
 
 class SGDState(NamedTuple):

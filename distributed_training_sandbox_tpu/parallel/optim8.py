@@ -1,0 +1,132 @@
+"""8-bit Adam moments: the at-rest optimizer state stored int8.
+
+The r4 memory accounting (EXPERIMENTS.md) put the flagship's Adam
+mu/nu at 3.31 GB of the 4.96 GB resident state — the largest block on
+the chip.  Storing both moments int8 with per-row fp32 scales cuts that
+to ~1.7 GB, which is the same order as the 2.3–2.7 GB OOM margins that
+killed the save_dots×int8 knob crossings (BENCH_r04) — the state-side
+attack on the 125.8 TFLOPS ceiling the r4 verdict prescribed (#4).
+
+Scheme (bitsandbytes-style blockwise, TPU-shaped):
+  * ``mu`` (signed): per-LAST-AXIS-row absmax / 127 linear int8 — rows
+    are the natural TPU-contiguous blocks and the scale tree keeps the
+    param's sharding spec (scales shard like the leaf, last dim 1).
+  * ``nu`` (nonnegative, huge dynamic range): quantized in the SQRT
+    domain — q = √v / scale, dequant v = (q·scale)² — which halves the
+    stored exponent range; per-row absmax again.
+  * update math runs in fp32 after dequant, exactly
+    ``optim.adam_update``'s kernel, then requantizes.  No error
+    feedback buffer (it would give back the memory the scheme exists to
+    save); the trajectory-parity test pins the consequence.
+
+The reference's analogue is its memory-for-throughput trades around
+FSDP state (``fsdp/train_fsdp.py:84-88``); 8-bit state is this repo's
+extension past it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .optim import AdamState
+
+
+class Q8(NamedTuple):
+    """One int8-stored moment leaf: codes + per-row fp32 scales."""
+    q: jax.Array       # int8, the param's shape
+    scale: jax.Array   # f32, shape[:-1] + (1,)
+
+
+def _quant_linear(x) -> Q8:
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return Q8(q=q, scale=scale)
+
+
+def _dequant_linear(m: Q8) -> jax.Array:
+    return m.q.astype(jnp.float32) * m.scale
+
+
+def _quant_sqrt(v) -> Q8:
+    s = jnp.sqrt(v)
+    amax = jnp.max(s, axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(s / scale), 0, 127).astype(jnp.int8)
+    return Q8(q=q, scale=scale)
+
+
+def _dequant_sqrt(m: Q8) -> jax.Array:
+    s = m.q.astype(jnp.float32) * m.scale
+    return s * s
+
+
+def adam8_init(params) -> AdamState:
+    """Zero moments in quantized form, sharded like the params they
+    track (the scale inherits the leaf's sharding minus its last dim).
+    1-D leaves (RMSNorm scales) stay full precision: their only dim may
+    be the FSDP-sharded one (a size-1 scale can't shard over it), and
+    their bytes are negligible."""
+
+    def zq(p):
+        if p.ndim < 2:
+            return jnp.zeros_like(p)
+        return Q8(q=jnp.zeros(p.shape, jnp.int8),
+                  scale=jnp.zeros(p.shape[:-1] + (1,), jnp.float32))
+
+    return AdamState(mu=jax.tree.map(zq, params),
+                     nu=jax.tree.map(zq, params),
+                     count=jnp.zeros((), jnp.int32))
+
+
+def adam8_update(grads, state: AdamState, params, *, lr=1e-3, b1=0.9,
+                 b2=0.999, eps=1e-8, lr_mults=None):
+    """``optim.adam_update`` with int8-at-rest moments: dequant → fp32
+    moment math → requant, per leaf.  The fp32 copies are transient
+    inside the fused step; only the int8 codes + scales persist."""
+    count = state.count + 1
+    c = count.astype(jnp.float32)
+    bc1 = 1 - b1 ** c
+    bc2 = 1 - b2 ** c
+
+    def leaf(p, g, mq, vq, s=1.0):
+        g32 = g.astype(jnp.float32)
+        quantized = isinstance(mq, Q8)
+        m_prev = _dequant_linear(mq) if quantized else mq.astype(jnp.float32)
+        v_prev = _dequant_sqrt(vq) if quantized else vq.astype(jnp.float32)
+        m = b1 * m_prev + (1 - b1) * g32
+        v = b2 * v_prev + (1 - b2) * g32 * g32
+        step = (lr * s) * (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+        new_p = (p.astype(jnp.float32) - step).astype(p.dtype)
+        if quantized:
+            return new_p, _quant_linear(m), _quant_sqrt(v)
+        return new_p, m.astype(mq.dtype), v.astype(vq.dtype)
+
+    # primary tree = params: its leaves line up with Q8 SUBTREES in
+    # mu/nu (tree.map flattens rest trees up to the primary's leaves)
+    if lr_mults is None:
+        out = jax.tree.map(leaf, params, grads, state.mu, state.nu)
+    else:
+        out = jax.tree.map(leaf, params, grads, state.mu, state.nu,
+                           lr_mults)
+    td = jax.tree.structure(params)
+    tups = td.flatten_up_to(out)
+    return (td.unflatten([t[0] for t in tups]),
+            AdamState(mu=td.unflatten([t[1] for t in tups]),
+                      nu=td.unflatten([t[2] for t in tups]),
+                      count=count))
+
+
+from functools import partial
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def adam8_step_donated(grads, state: AdamState, params, lr):
+    """One compiled donated program, the ``optim.adam_step_donated``
+    twin for int8 state — pipeline stages at billion-param scale need
+    the in-place update either way, and the int8 codes make the
+    at-rest state ~2× smaller on top."""
+    return adam8_update(grads, state, params, lr=lr)

@@ -34,6 +34,15 @@ def main(schedule: str, argv=None):
                         "stage that transformer config "
                         "(build_transformer_pipeline)")
     p.add_argument("--results-file", type=str, default=None)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--warmup-epochs", type=int, default=0,
+                   help="linear LR warmup over this many epochs — "
+                        "large-vocab transformers need it here as much "
+                        "as the flagship loop does")
+    p.add_argument("--opt8", action="store_true",
+                   help="int8-at-rest Adam moments per stage "
+                        "(parallel.optim8) — halves the biggest "
+                        "resident block for billion-param stage sets")
     args, rest = p.parse_known_args(argv)
 
     if args.cpu_devices:
@@ -79,7 +88,8 @@ def main(schedule: str, argv=None):
         mcfg: T.TransformerConfig = getattr(T, MODELS[args.model])
         params = T.init_params(key, mcfg)
         stages = build_transformer_pipeline(params, mcfg, args.n_stages,
-                                            devices=devices)
+                                            devices=devices,
+                                            opt8=args.opt8)
 
         def make_batch(epoch):
             # packed-window contract (inputs = w[:-1], labels = w[1:]),
@@ -103,9 +113,14 @@ def main(schedule: str, argv=None):
         if prof:
             prof.step()
 
+    if args.warmup_epochs:
+        def lr_fn(e, *, _w=args.warmup_epochs, _lr=args.lr):
+            return _lr * min(1.0, (e + 1) / _w)
+    else:
+        lr_fn = args.lr
     result = train_pipeline(stages, schedule, make_batch,
                             num_epochs=cfg.num_epochs, n_micro=args.n_micro,
-                            log=log)
+                            lr=lr_fn, log=log)
     if prof:
         prof.stop()
 

@@ -193,30 +193,37 @@ def longctx_table(rows: list[dict]) -> str:
 def decode_table(rows: list[dict]) -> str:
     if not rows:
         return "_no decode benchmark found_\n"
-    out = ["Decode is weight-read-bound: the roofline column is "
-           "`weight_bytes / HBM bandwidth` per step; int8 rows store "
-           "weights AS int8 (`quantize_decode_params`), halving the "
-           "floor.\n",
+    out = ["Decode is read-bound: the roofline column is "
+           "`(weight_bytes + KV_cache_bytes) / HBM bandwidth` per step "
+           "(r5: the KV term was previously omitted, flattering short "
+           "prompts).  int8 rows store weights AS int8 "
+           "(`quantize_decode_params`); `+kvq` rows also store the KV "
+           "cache int8 — both lower the floor itself.\n",
            "| model | precision | batch | prompt | new | weight GiB | "
-           "steady tok/s | ms/step | roofline ms | roofline frac | "
-           "prefill+1 s |",
-           "|---|---|---|---|---|---|---|---|---|---|---|"]
+           "KV GiB | steady tok/s | ms/step | roofline ms | "
+           "roofline frac | prefill+1 s | status |",
+           "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
     for r in rows:
         if "failure" in r or "error" in r:
+            # failure kind goes in the dedicated status column, not in a
+            # mislabeled data cell (r4 advisor)
             out.append(f"| {r['model']} | {r.get('precision', '—')} | "
                        f"{r.get('batch', '—')} | {r.get('prompt_len', '—')}"
-                       f" | — | — | — | — | — | — | "
+                       f" | — | — | — | — | — | — | — | — | "
                        f"{r.get('failure', 'error')} |")
             continue
+        roofline = r.get("read_roofline_ms_per_step",
+                         r.get("weight_read_roofline_ms_per_step", "—"))
         out.append(
             f"| {r['model']} | {r.get('precision', 'bf16')} | "
             f"{r['batch']} | {r['prompt_len']} | {r['new_tokens']} | "
             f"{r.get('weight_gib', '—')} | "
+            f"{r.get('kv_cache_gib', '—')} | "
             f"{r.get('steady_decode_tokens_per_sec', '—')} | "
             f"{r.get('steady_ms_per_step', r.get('steady_ms_per_token_per_seq', '—'))} | "
-            f"{r.get('weight_read_roofline_ms_per_step', '—')} | "
+            f"{roofline} | "
             f"{r.get('roofline_fraction', '—')} | "
-            f"{r.get('prefill_plus_1_s', '—')} |")
+            f"{r.get('prefill_plus_1_s', '—')} | ok |")
     out.append("")
     return "\n".join(out)
 

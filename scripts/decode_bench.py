@@ -37,8 +37,21 @@ def weight_bytes(params) -> int:
     return tree_size_bytes(params)
 
 
+def kv_bytes_per_step(cfg, batch: int, s_max: int, kv_quant: bool) -> int:
+    """HBM bytes the attention READS from the KV cache per decode step:
+    batch × S_max × layers × n_kv × hd × 2 (K and V) × itemsize.  The
+    cache is a static (B, S_max, ...) buffer, so every step reads the
+    whole capacity (masked), not just the live prefix — the honest
+    denominator.  int8 cache adds the f32 row scales (hd→4 bytes)."""
+    elems = batch * s_max * cfg.num_hidden_layers \
+        * cfg.num_key_value_heads * cfg.resolved_head_dim * 2
+    if kv_quant:
+        return elems + (elems // cfg.resolved_head_dim) * 4
+    return elems * 2          # bf16
+
+
 def run_one(cfg, params, precision: str, batch: int, prompt_len: int,
-            new_tokens: int, platform: str) -> dict:
+            new_tokens: int, platform: str, kv_quant: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -50,38 +63,48 @@ def run_one(cfg, params, precision: str, batch: int, prompt_len: int,
     # two windows — prefill+1 token vs prefill+N tokens — so the
     # STEADY-STATE decode rate is (N−1)·B / (tN − t1), prefill excluded.
     for n in (1, new_tokens):            # compile both programs first
-        np.asarray(generate(params, prompt, cfg, max_new_tokens=n))
+        np.asarray(generate(params, prompt, cfg, max_new_tokens=n,
+                            kv_quant=kv_quant))
     p2 = jnp.roll(prompt, 1, axis=1)
     t0 = time.perf_counter()
-    np.asarray(generate(params, p2, cfg, max_new_tokens=1))
+    np.asarray(generate(params, p2, cfg, max_new_tokens=1,
+                        kv_quant=kv_quant))
     t1 = time.perf_counter() - t0
     t0 = time.perf_counter()
-    np.asarray(generate(params, p2, cfg, max_new_tokens=new_tokens))
+    np.asarray(generate(params, p2, cfg, max_new_tokens=new_tokens,
+                        kv_quant=kv_quant))
     tN = time.perf_counter() - t0
     step_s = (tN - t1) / max(new_tokens - 1, 1)
     steady = (new_tokens - 1) * batch / max(tN - t1, 1e-9)
 
     wb = weight_bytes(params)
+    kvb = kv_bytes_per_step(cfg, batch, prompt_len + new_tokens, kv_quant)
     bw = HBM_GBPS.get(platform)
-    roofline_ms = wb / (bw * 1e9) * 1e3 if bw else None
+    # The roofline counts every mandatory HBM READ of a step: all weight
+    # bytes + the whole KV cache (the r4 rows counted weights only,
+    # flattering short prompts and hiding the long-prompt gap).  Cache
+    # WRITES per step are one token column — negligible.
+    roofline_ms = (wb + kvb) / (bw * 1e9) * 1e3 if bw else None
     row = {
-        "precision": precision, "batch": batch, "prompt_len": prompt_len,
+        "precision": precision + ("+kvq" if kv_quant else ""),
+        "batch": batch, "prompt_len": prompt_len,
         "new_tokens": new_tokens,
         "weight_gib": round(wb / 2**30, 3),
+        "kv_cache_gib": round(kvb / 2**30, 3),
         "prefill_plus_1_s": round(t1, 3),
         "total_s": round(tN, 3),
         "steady_decode_tokens_per_sec": round(steady, 1),
         "steady_ms_per_step": round(step_s * 1e3, 2),
         "steady_ms_per_token_per_seq": round(step_s * 1e3, 2),
-        "weight_read_roofline_ms_per_step": (round(roofline_ms, 2)
-                                             if roofline_ms else None),
+        "read_roofline_ms_per_step": (round(roofline_ms, 2)
+                                      if roofline_ms else None),
         "roofline_fraction": (round(roofline_ms / (step_s * 1e3), 3)
                               if roofline_ms else None),
     }
-    print(f"[decode] {precision} b{batch} p{prompt_len} n{new_tokens}: "
-          f"{row['steady_ms_per_step']} ms/step "
+    print(f"[decode] {row['precision']} b{batch} p{prompt_len} "
+          f"n{new_tokens}: {row['steady_ms_per_step']} ms/step "
           f"({row['steady_decode_tokens_per_sec']:.0f} tok/s, "
-          f"roofline {row['weight_read_roofline_ms_per_step']} ms, "
+          f"roofline {row['read_roofline_ms_per_step']} ms, "
           f"{row['roofline_fraction']})", flush=True)
     return row
 
@@ -94,6 +117,9 @@ def main(argv=None):
     p.add_argument("--prompt", type=int, default=128)
     p.add_argument("--new", type=int, default=128)
     p.add_argument("--sweep", action="store_true")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="store the KV cache int8 (+per-row scales): "
+                        "half the cache-read bytes per step")
     p.add_argument("--out-dir", default="decode_results")
     p.add_argument("--out-file", default=None,
                    help="output filename (default decode_<platform>.json"
@@ -130,19 +156,22 @@ def main(argv=None):
 
     if args.sweep:
         # grouped by precision so the lazy param cache rebuilds once;
-        # the (8, 2048) cell is the long-prompt prefill/decode split
-        cells = [(1, args.prompt), (8, args.prompt), (32, args.prompt),
-                 (8, 2048)]
-        grid = [(prec, b, plen, args.new)
-                for prec in ("bf16", "int8") for b, plen in cells]
+        # the (8, 2048) cells are the long-prompt prefill/decode split —
+        # where the KV read matters, also measured with the int8 cache
+        cells = [(1, args.prompt, False), (8, args.prompt, False),
+                 (32, args.prompt, False),
+                 (8, 2048, False), (8, 2048, True), (32, args.prompt, True)]
+        grid = [(prec, b, plen, args.new, kvq)
+                for prec in ("bf16", "int8") for b, plen, kvq in cells]
     else:
-        grid = [(args.precision, args.batch, args.prompt, args.new)]
+        grid = [(args.precision, args.batch, args.prompt, args.new,
+                 args.kv_quant)]
 
-    for prec, b, plen, new in grid:
+    for prec, b, plen, new, kvq in grid:
         try:
             rows.append({"model": args.model, "platform": platform,
                          **run_one(cfg, params_for(prec), prec, b, plen,
-                                   new, platform)})
+                                   new, platform, kv_quant=kvq)})
         except Exception as e:
             from distributed_training_sandbox_tpu.utils import (
                 classify_failure)

@@ -1,0 +1,123 @@
+"""Build the committed REAL-TEXT corpus + tokenizer for offline training.
+
+The reference trains its flagship on real TinyStories text
+(``fsdp/utils.py:29-91``); this environment has zero egress, so the
+corpus must come from text already on disk.  The richest real English
+prose available offline is the installed scientific-Python stack's own
+documentation: docstrings are genuine human-written natural language
+(several MB across numpy/scipy/jax/sklearn/pandas/torch), with enough
+topical structure (linear algebra vs IO vs statistics vs plotting) that
+a language model — and an MoE router — has something real to learn.
+
+Extraction is ``ast``-based (no imports of the scanned packages):
+every module/class/function docstring ≥ ``MIN_CHARS`` from the packages
+listed below, internal blank lines collapsed so each docstring stays ONE
+document under ``data.packing.read_corpus_documents``'s blank-line
+splitting rule, deduplicated by content hash, deterministically shuffled.
+
+Outputs (committed):
+  * ``data/corpus/docstrings.txt``   — ~TARGET_MB of real text
+  * ``data/corpus/tokenizer.json``   — BPE vocab 8192 trained on it
+
+    python scripts/make_corpus.py
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import random
+import sys
+import sysconfig
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+OUT_DIR = ROOT / "data" / "corpus"
+PACKAGES = ["numpy", "scipy", "sklearn", "pandas", "matplotlib", "jax",
+            "torch", "flax", "optax", "chex", "einops", "transformers"]
+MIN_CHARS = 200
+TARGET_MB = 8.0
+VOCAB = 8192
+
+
+def iter_docstrings(py_file: Path):
+    try:
+        tree = ast.parse(py_file.read_text(errors="ignore"))
+    except (SyntaxError, ValueError, OSError):
+        return
+    nodes = [tree] + [n for n in ast.walk(tree)
+                      if isinstance(n, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.ClassDef))]
+    for n in nodes:
+        doc = ast.get_docstring(n, clean=True)
+        if doc and len(doc) >= MIN_CHARS:
+            yield doc
+
+
+def normalize(doc: str) -> str:
+    # one docstring = one document: collapse internal blank lines so the
+    # corpus reader's blank-line document splitting keeps it whole
+    lines = [ln.rstrip() for ln in doc.splitlines()]
+    return "\n".join(ln for ln in lines if ln.strip())
+
+
+def mostly_english(doc: str) -> bool:
+    ascii_frac = sum(c.isascii() for c in doc) / len(doc)
+    alpha_frac = sum(c.isalpha() or c.isspace() for c in doc) / len(doc)
+    return ascii_frac > 0.97 and alpha_frac > 0.55
+
+
+def main() -> None:
+    site = Path(sysconfig.get_paths()["purelib"])
+    docs, seen = [], set()
+    for pkg in PACKAGES:
+        pdir = site / pkg
+        if not pdir.is_dir():
+            print(f"[corpus] skip {pkg} (not installed)")
+            continue
+        n0 = len(docs)
+        for f in sorted(pdir.rglob("*.py")):
+            if "test" in f.parts or f.name.startswith("test_"):
+                continue
+            for doc in iter_docstrings(f):
+                doc = normalize(doc)
+                if not mostly_english(doc):
+                    continue
+                h = hashlib.sha1(doc.encode()).hexdigest()
+                if h in seen:
+                    continue
+                seen.add(h)
+                docs.append(doc)
+        print(f"[corpus] {pkg}: +{len(docs) - n0} docs")
+
+    random.Random(42).shuffle(docs)
+    budget = int(TARGET_MB * 1e6)
+    kept, size = [], 0
+    for d in docs:
+        kept.append(d)
+        size += len(d) + 2
+        if size >= budget:
+            break
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    corpus = OUT_DIR / "docstrings.txt"
+    corpus.write_text("\n\n".join(kept) + "\n")
+    print(f"[corpus] {len(kept)} documents, {size / 1e6:.2f} MB "
+          f"-> {corpus}")
+
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.BpeTrainer(
+        vocab_size=VOCAB, special_tokens=["<unk>", "<eos>"],
+        show_progress=False)
+    tok.train([str(corpus)], trainer)
+    out = OUT_DIR / "tokenizer.json"
+    tok.save(str(out))
+    n = tok.get_vocab_size()
+    print(f"[corpus] tokenizer vocab {n} -> {out}")
+    if n > VOCAB:
+        sys.exit(f"vocab {n} exceeds target {VOCAB}")
+
+
+if __name__ == "__main__":
+    main()

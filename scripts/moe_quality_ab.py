@@ -75,7 +75,7 @@ def _mlp_drop_tap(T, expert_mod):
 
 def run_leg(name: str, cfg_overrides: dict, seconds: float, seq: int,
             bs: int, peak_lr: float, warmup: int, eval_every: int,
-            data, eval_batch) -> dict:
+            data, eval_batch, base: str = "SMOLLM3_3B_L8") -> dict:
     import jax
     import jax.numpy as jnp
     from distributed_training_sandbox_tpu.models import transformer as T
@@ -87,7 +87,7 @@ def run_leg(name: str, cfg_overrides: dict, seconds: float, seq: int,
     over.setdefault(
         "attention_impl",
         "flash" if jax.default_backend() == "tpu" else "xla")
-    mcfg = dataclasses.replace(T.SMOLLM3_3B_L8, **over)
+    mcfg = dataclasses.replace(getattr(T, base), **over)
     mesh = make_mesh()
     key = set_seed(42)
     params = T.init_params(key, mcfg)
@@ -113,31 +113,37 @@ def run_leg(name: str, cfg_overrides: dict, seconds: float, seq: int,
     n = len(ii)
     losses, times, evals, drops = [], [], [], []
     i = 0
-    t0 = None
+    # The budget clock counts TRAIN time only: eval and drop-metric
+    # computations run OFF the clock.  The r4 A/B timed them inside the
+    # budget, so MoE legs (which also pay for drop_fn) weren't
+    # throughput-comparable with dense — the 9k-vs-16k tok/s
+    # inconsistency the verdict flagged (Weak #3).
+    train_s = 0.0
     while True:
         j = i % (n // bs)
         batch = (jnp.asarray(ii[j * bs:(j + 1) * bs]),
                  jnp.asarray(ll[j * bs:(j + 1) * bs]))
         if drop_fn is not None and i % eval_every == 0:
             drops.append((i, float(drop_fn(shards, batch[0]))))
-        shards, opt, loss = step(shards, opt, batch)
         if i % eval_every == 0:
             evals.append((i, float(eval_loss(shards, eval_batch)),
-                          0.0 if t0 is None else time.perf_counter() - t0))
-        losses.append(float(loss))
-        if t0 is None:
-            t0 = time.perf_counter()   # clock starts after compile step
-        times.append(time.perf_counter() - t0)
+                          train_s))
+        t0 = time.perf_counter()
+        shards, opt, loss = step(shards, opt, batch)
+        losses.append(float(loss))        # the float() sync closes the step
+        if i > 0:                         # step 0 = compile, off the clock
+            train_s += time.perf_counter() - t0
+        times.append(train_s)
         i += 1
-        if times[-1] > seconds:
+        if train_s > seconds:
             break
         if i % 25 == 0:
             print(f"[moe-ab:{name}] step {i:4d} loss {losses[-1]:7.4f} "
-                  f"t {times[-1]:5.0f}s"
+                  f"t {train_s:5.0f}s"
                   + (f" drop {drops[-1][1]:.3f}" if drops else ""),
                   flush=True)
     final_eval = float(eval_loss(shards, eval_batch))
-    tok_s = (len(losses) - 1) * bs * seq / times[-1]
+    tok_s = (len(losses) - 1) * bs * seq / train_s
     print(f"[moe-ab:{name}] done: {len(losses)} steps, "
           f"{tok_s:.0f} tok/s, final eval {final_eval:.4f}", flush=True)
     return {
@@ -197,6 +203,25 @@ def main(argv=None):
                         "COLLAPSING (drop rate 0.10→0.65 as it trains); "
                         "re-run with 0.1 to test whether a stronger "
                         "balance loss rescues the throughput win")
+    p.add_argument("--z-weight", type=float, default=0.0,
+                   help="router z-loss weight (ST-MoE): keeps router "
+                        "logits small so the balance aux keeps gradient "
+                        "signal — the r5 router-health knob")
+    p.add_argument("--router-lr-mult", type=float, default=1.0,
+                   help="LR multiplier on w_router leaves (<1 slows the "
+                        "router relative to the experts)")
+    p.add_argument("--capacity-factors", type=float, nargs="+",
+                   default=[2.0, 1.0],
+                   help="one MoE leg per capacity factor")
+    p.add_argument("--data", choices=["synthetic", "corpus"],
+                   default="synthetic",
+                   help="'corpus' = the committed real-text corpus "
+                        "(data/corpus/, vocab 8192) — pair with "
+                        "--geometry corpus-70m")
+    p.add_argument("--geometry", default=None,
+                   help="model registry name for the base geometry "
+                        "(default: the 3B-L8 flagship; 'corpus-70m' for "
+                        "real-text runs)")
     p.add_argument("--tag", default="",
                    help="suffix for the output json/plot (e.g. aux01)")
     p.add_argument("--skip-dense", action="store_true",
@@ -219,21 +244,36 @@ def main(argv=None):
 
     import jax
     from distributed_training_sandbox_tpu.data import make_packed_dataset
-    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.models import (
+        MODEL_REGISTRY, transformer as T)
 
     seq, bs = args.sequence_length, args.batch_size
+    base = (MODEL_REGISTRY[args.geometry] if args.geometry
+            else "SMOLLM3_3B_L8")
     moe = _base_moe()
+    if base == "CORPUS_LM":
+        # scale the expert width with the geometry: dense ffn / 4 keeps
+        # the "dense MLP FLOPs split 4-ways active" shape of the 3B MoE
+        moe = {**moe, "moe_ffn": T.CORPUS_LM.intermediate_size // 4}
     tiny_over = {}
     if args.tiny:
         seq, bs = 128, 4
         tiny_over = dataclasses.asdict(T.TINY_LM)
         moe = {**_base_moe(), "n_experts": 4, "moe_ffn": 40}
 
-    vocab = (tiny_over or dataclasses.asdict(T.SMOLLM3_3B_L8))["vocab_size"]
-    # ~400 steps of fresh windows, looped if a leg outruns them; +8 eval
-    n_tok = (400 * bs + 8) * (seq + 1)
-    ii, ll = make_packed_dataset(seq, vocab, num_tokens=n_tok,
-                                 source="synthetic", engine="native")
+    vocab = (tiny_over or dataclasses.asdict(getattr(T, base)))["vocab_size"]
+    if args.data == "corpus":
+        root = Path(__file__).resolve().parent.parent
+        ii, ll = make_packed_dataset(
+            seq, vocab, source="corpus",
+            corpus_path=root / "data" / "corpus" / "docstrings.txt",
+            tokenizer_file=root / "data" / "corpus" / "tokenizer.json")
+        print(f"[moe-ab] corpus: {len(ii)} windows of seq {seq}")
+    else:
+        # ~400 steps of fresh windows, looped if a leg outruns them
+        n_tok = (400 * bs + 8) * (seq + 1)
+        ii, ll = make_packed_dataset(seq, vocab, num_tokens=n_tok,
+                                     source="synthetic", engine="native")
     import jax.numpy as jnp
     eval_batch = (jnp.asarray(ii[-8:]), jnp.asarray(ll[-8:]))
     data = (ii[:-8], ll[:-8])
@@ -241,20 +281,22 @@ def main(argv=None):
     def with_tiny(over):
         return {**tiny_over, **over} if args.tiny else over
 
-    aw = args.aux_weight
-    aux_tag = "" if aw == 0.01 else f"_aux{aw:g}"
+    aw, zw, rlm = args.aux_weight, args.z_weight, args.router_lr_mult
+    health_tag = ("" if aw == 0.01 else f"_aux{aw:g}") \
+        + (f"_z{zw:g}" if zw else "") + (f"_rlm{rlm:g}" if rlm != 1.0 else "")
+    health = {"moe_aux_weight": aw, "moe_router_z_weight": zw,
+              "moe_router_lr_mult": rlm}
     leg_list = [] if args.skip_dense else [("dense", {})]
     leg_list += [
-        (f"moe_cf2.0{aux_tag}", {**moe, "moe_capacity_factor": 2.0,
-                                 "moe_aux_weight": aw}),
-        (f"moe_cf1.0{aux_tag}", {**moe, "moe_capacity_factor": 1.0,
-                                 "moe_aux_weight": aw}),
+        (f"moe_cf{cf:g}{health_tag}",
+         {**moe, "moe_capacity_factor": cf, **health})
+        for cf in args.capacity_factors
     ]
     legs = []
     for name, over in leg_list:
         legs.append(run_leg(name, with_tiny(over), args.seconds, seq, bs,
                             args.peak_lr, args.warmup_steps,
-                            args.eval_every, data, eval_batch))
+                            args.eval_every, data, eval_batch, base=base))
 
     if args.skip_dense:
         prior = Path(args.out_dir) / f"quality_ab_{jax.devices()[0].platform}.json"

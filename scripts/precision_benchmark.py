@@ -175,9 +175,13 @@ def main(argv=None):
                                        args.num_steps, bs, out_dir))
             except Exception as e:
                 kind, msg = classify_failure(e)
+                import jax
                 results.append({
                     "model": args.model, "precision": precision,
                     "sequence_length": seq, "batch_size": bs,
+                    # keyed fields must match success rows so the
+                    # analyzer's last-write-wins eviction pairs them
+                    "num_devices": len(jax.devices()),
                     "failure": kind, "error": msg})
                 print(f"[precision] {args.model}/{precision}/seq{seq}"
                       f"/b{bs} {kind.upper()}: {msg[:120]}")

@@ -296,3 +296,50 @@ def test_ep_training_learns(mesh8, moe_params):
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7, losses[::10]
     assert "dp" in str(shards.w_gate.sharding.spec)
+
+
+# ------------------------------------------------- router health knobs
+
+def test_router_z_loss_value_and_aux_channel(moe_params):
+    """z-loss = mean logsumexp(logits)²: exact at zero logits
+    (log E)², and a nonzero ratio raises moe_mlp's aux by exactly
+    ratio · z — the channel the config's moe_router_z_weight rides."""
+    x = _tokens(jax.random.PRNGKey(7), 2, 16)
+    z0 = expert.router_z_loss(jnp.zeros((4, HID)),
+                              jnp.zeros((HID, NEXP)))
+    assert float(z0) == pytest.approx(np.log(NEXP) ** 2, rel=1e-6)
+
+    args = (x, moe_params.w_router, moe_params.w_gate, moe_params.w_up,
+            moe_params.w_down)
+    _, aux_plain = expert.moe_mlp(*args, axis=None)
+    y, aux_z = expert.moe_mlp(*args, axis=None, router_z_ratio=0.5)
+    z = expert.router_z_loss(x.reshape(-1, HID), moe_params.w_router)
+    assert float(aux_z) == pytest.approx(float(aux_plain) + 0.5 * float(z),
+                                         rel=1e-5)
+    # output tokens unchanged — z only shapes the aux/grad channel
+    y_plain, _ = expert.moe_mlp(*args, axis=None)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_plain))
+
+
+def test_adam_lr_mults_scale_only_matching_leaves():
+    """Per-leaf LR multipliers: mult 0 freezes a leaf, mult 1 matches the
+    plain update — the mechanism behind moe_router_lr_mult."""
+    params = {"w_router": jnp.ones((4, 4)), "other": jnp.ones((4,))}
+    grads = jax.tree.map(jnp.ones_like, params)
+    st = optim.adam_init(params)
+    plain, _ = optim.adam_update(grads, st, params, lr=1e-2)
+    mults = {"w_router": 0.0, "other": 1.0}
+    scaled, _ = optim.adam_update(grads, st, params, lr=1e-2,
+                                  lr_mults=mults)
+    np.testing.assert_allclose(np.asarray(scaled["w_router"]),
+                               np.asarray(params["w_router"]))
+    np.testing.assert_allclose(np.asarray(scaled["other"]),
+                               np.asarray(plain["other"]))
+
+
+def test_router_z_weight_requires_aux_weight():
+    import dataclasses
+    from distributed_training_sandbox_tpu.models import transformer as T
+    with pytest.raises(ValueError, match="moe_aux_weight"):
+        dataclasses.replace(T.TINY_LM, n_experts=4, moe_ffn=32,
+                            moe_router_z_weight=1e-3, moe_aux_weight=0.0)

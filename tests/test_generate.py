@@ -173,4 +173,24 @@ def test_tp_decode_cache_is_sharded(mesh2x4):
     cfg = T.TINY_LM
     c2 = init_cache(cfg, 2, 16, tp=2)
     c1 = init_cache(cfg, 2, 16)
-    assert c2.k.shape[3] == c1.k.shape[3] // 2
+    # per-layer buffers (B, S_max, n_kv, hd): head dim is axis 2
+    assert len(c1.k) == cfg.num_hidden_layers
+    assert c2.k[0].shape[2] == c1.k[0].shape[2] // 2
+
+
+def test_kv_quant_decode_tracks_bf16_decode():
+    """int8 KV cache (per-row scales): greedy tokens must track the
+    bf16-cache chain closely — the quantization noise is per-row ≤
+    1/254 relative, far below typical logit margins, so demand ≥ 90%
+    token agreement and identical first steps."""
+    cfg = T.TINY_LM
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                cfg.vocab_size)
+    ref = np.asarray(generate(params, prompt, cfg, max_new_tokens=20))
+    got = np.asarray(generate(params, prompt, cfg, max_new_tokens=20,
+                              kv_quant=True))
+    assert got.shape == ref.shape
+    agree = (got == ref).mean()
+    assert agree >= 0.9, f"int8-KV agreement {agree:.2f}"
+    np.testing.assert_array_equal(got[:, 0], ref[:, 0])
