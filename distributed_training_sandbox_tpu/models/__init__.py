@@ -21,4 +21,5 @@ MODEL_REGISTRY = {
     "tiny": "TINY_LM",
     "tiny8": "TINY_LM_L8",
     "corpus-70m": "CORPUS_LM",
+    "corpus-350m": "CORPUS_350M",
 }

@@ -238,6 +238,14 @@ CORPUS_LM = TransformerConfig(
     num_hidden_layers=8, num_attention_heads=12, num_key_value_heads=4,
     head_dim=64, rope_theta=10_000.0, nope_interval=0,
     attention_impl="flash")
+
+# 350M-class real-text flagship: the SmolLM3-350M geometry at the corpus
+# tokenizer's vocab (49k→8k trims the embedding; ~270 M params remain) —
+# the substrate for the ≥500-step real-text flagship run.
+CORPUS_350M = TransformerConfig(
+    vocab_size=8192, hidden_size=960, intermediate_size=2560,
+    num_hidden_layers=32, num_attention_heads=15, num_key_value_heads=5,
+    head_dim=64, nope_interval=0, attention_impl="flash")
 # 8-layer sibling: depth experiments (4-stage / interleaved pipelines
 # need more layers than TINY_LM's 4).
 TINY_LM_L8 = replace(TINY_LM, num_hidden_layers=8)

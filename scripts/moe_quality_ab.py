@@ -213,6 +213,13 @@ def main(argv=None):
     p.add_argument("--capacity-factors", type=float, nargs="+",
                    default=[2.0, 1.0],
                    help="one MoE leg per capacity factor")
+    p.add_argument("--top-k", type=int, default=1,
+                   help="experts per token (2 = GShard top-2: ~2x "
+                        "active MLP FLOPs, usually better quality)")
+    p.add_argument("--dense-from", default=None,
+                   help="with --skip-dense: json file to read the dense "
+                        "baseline eval from (default: the untagged "
+                        "quality_ab_<platform>.json)")
     p.add_argument("--data", choices=["synthetic", "corpus"],
                    default="synthetic",
                    help="'corpus' = the committed real-text corpus "
@@ -283,9 +290,10 @@ def main(argv=None):
 
     aw, zw, rlm = args.aux_weight, args.z_weight, args.router_lr_mult
     health_tag = ("" if aw == 0.01 else f"_aux{aw:g}") \
-        + (f"_z{zw:g}" if zw else "") + (f"_rlm{rlm:g}" if rlm != 1.0 else "")
+        + (f"_z{zw:g}" if zw else "") + (f"_rlm{rlm:g}" if rlm != 1.0 else "") \
+        + (f"_top{args.top_k}" if args.top_k != 1 else "")
     health = {"moe_aux_weight": aw, "moe_router_z_weight": zw,
-              "moe_router_lr_mult": rlm}
+              "moe_router_lr_mult": rlm, "moe_top_k": args.top_k}
     leg_list = [] if args.skip_dense else [("dense", {})]
     leg_list += [
         (f"moe_cf{cf:g}{health_tag}",
@@ -299,7 +307,9 @@ def main(argv=None):
                             args.eval_every, data, eval_batch, base=base))
 
     if args.skip_dense:
-        prior = Path(args.out_dir) / f"quality_ab_{jax.devices()[0].platform}.json"
+        prior = Path(args.dense_from) if args.dense_from else (
+            Path(args.out_dir)
+            / f"quality_ab_{jax.devices()[0].platform}.json")
         dense_eval = json.loads(prior.read_text())["verdict"]["dense"][
             "final_eval_loss"] if prior.exists() else float("nan")
     else:

@@ -39,7 +39,7 @@ from distributed_training_sandbox_tpu.models import MODEL_REGISTRY  # noqa: E402
 
 def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
             warmup_steps: int, peak_lr: float, out_dir: Path,
-            tag_suffix: str = "") -> dict:
+            tag_suffix: str = "", data: str = "synthetic") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -65,15 +65,31 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
     step = fsdp.make_fsdp_train_step(shards, mcfg, mesh, lr=peak_lr,
                                      lr_schedule=sched)
 
-    # fresh windows for every step (engine="native": the C++ sampler, ~10x
-    # faster stream builds at this size)
-    n_tokens = num_steps * bs * (seq + 1) + seq + 1
-    ii, ll = make_packed_dataset(seq, mcfg.vocab_size, num_tokens=n_tokens,
-                                 source="synthetic", engine="native")
+    if data == "corpus":
+        # the committed real-text corpus (reference trains its flagship
+        # on real TinyStories text, fsdp/utils.py:29-91); loops epochs
+        # when num_steps outruns the stream
+        root = Path(__file__).resolve().parent.parent
+        ii, ll = make_packed_dataset(
+            seq, mcfg.vocab_size, source="corpus",
+            corpus_path=root / "data" / "corpus" / "docstrings.txt",
+            tokenizer_file=root / "data" / "corpus" / "tokenizer.json")
+        epochs = -(-num_steps * bs // max(len(ii), 1))
+        print(f"[flagship] corpus: {len(ii)} windows x seq {seq} "
+              f"({epochs} epoch(s) for {num_steps} steps)")
+    else:
+        # fresh windows for every step (engine="native": the C++ sampler,
+        # ~10x faster stream builds at this size)
+        n_tokens = num_steps * bs * (seq + 1) + seq + 1
+        ii, ll = make_packed_dataset(seq, mcfg.vocab_size,
+                                     num_tokens=n_tokens,
+                                     source="synthetic", engine="native")
+        epochs = 1
 
     losses, lrs, times = [], [], []
     t0 = time.perf_counter()
-    for i, (ib, lb) in enumerate(packed_batches(ii, ll, bs)):
+    for i, (ib, lb) in enumerate(packed_batches(ii, ll, bs,
+                                                epochs=epochs)):
         if i >= num_steps:
             break
         shards, opt, loss = step(shards, opt,
@@ -88,10 +104,11 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
     tok_s = (len(losses) - 1) * bs * seq / dt if dt > 0 else 0.0
 
     warm = f"warm{warmup_steps}" if warmup_steps else "nowarm"
-    tag = f"{model}_{precision}_seq{seq}_b{bs}_{warm}{tag_suffix}"
+    corp = "_corpus" if data == "corpus" else ""
+    tag = f"{model}_{precision}_seq{seq}_b{bs}_{warm}{corp}{tag_suffix}"
     result = {
         "model": model, "precision": precision, "sequence_length": seq,
-        "batch_size": bs, "num_steps": len(losses),
+        "batch_size": bs, "data": data, "num_steps": len(losses),
         "warmup_steps": warmup_steps, "peak_lr": peak_lr,
         "devices": ws, "platform": jax.devices()[0].platform,
         "tokens_per_second": round(tok_s, 1),
@@ -146,6 +163,10 @@ def main(argv=None):
     p.add_argument("--spike-demo", action="store_true",
                    help="first run a short no-warmup leg to pin the "
                         "cold-Adam step-2 spike")
+    p.add_argument("--data", choices=["synthetic", "corpus"],
+                   default="synthetic",
+                   help="'corpus' = the committed real-text corpus "
+                        "(vocab 8192 — pair with a corpus-* model)")
     p.add_argument("--cpu-devices", type=int, default=0)
     p.add_argument("--out-dir", default="flagship_results")
     p.add_argument("--plot", default="plots/flagship_loss.png")
@@ -158,10 +179,11 @@ def main(argv=None):
     out_dir = Path(args.out_dir)
     if args.spike_demo:
         run_leg(args.model, args.precision, args.sequence_length,
-                args.batch_size, 30, 0, args.peak_lr, out_dir)
+                args.batch_size, 30, 0, args.peak_lr, out_dir,
+                data=args.data)
     run_leg(args.model, args.precision, args.sequence_length,
             args.batch_size, args.num_steps, args.warmup_steps,
-            args.peak_lr, out_dir)
+            args.peak_lr, out_dir, data=args.data)
     plot(out_dir, Path(args.plot))
 
 

@@ -120,6 +120,7 @@ def test_pad_invariance(cls_setup):
                                np.asarray(b, np.float32), atol=2e-2)
 
 
+@pytest.mark.slow  # tier-2: same machinery pinned faster elsewhere (suite-time budget, r4 verdict #8c)
 def test_ddp_classification_trains(mesh8):
     """End-to-end: the DDP choreography (broadcast + per-param psum + SGD)
     drives the classification loss below chance on the learnable synthetic

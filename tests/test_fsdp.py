@@ -225,3 +225,26 @@ def test_fsdp_step_applies_lr_schedule(mesh8):
     deltas = [float(jnp.abs(a - b).max()) for a, b in
               zip(jax.tree.leaves(shards), jax.tree.leaves(moved))]
     assert max(deltas) > 0
+
+
+def test_int8_state_step_learns_and_shards(setup, mesh8):
+    """state_precision='int8' (optim8 moments at rest): the step runs
+    under the same shard_map choreography, the loss falls, and the
+    moment codes keep the params' FSDP placement."""
+    from distributed_training_sandbox_tpu.parallel.optim8 import Q8
+
+    _, shards, batch = setup
+    step = fsdp.make_fsdp_train_step(shards, CFG, mesh8, donate=False,
+                                     lr=1e-3, state_precision="int8")
+    opt = fsdp.init_fsdp_opt_state8(shards)
+    leaf = opt.mu["embed"]
+    assert isinstance(leaf, Q8) and leaf.q.dtype == jnp.int8
+    losses = []
+    for _ in range(6):
+        shards, opt, loss = step(shards, opt, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # moments stayed int8 + sharded like the params
+    leaf = opt.mu["embed"]
+    assert leaf.q.dtype == jnp.int8
+    assert leaf.q.sharding.spec == shards["embed"].sharding.spec

@@ -10,6 +10,7 @@ ONE global 4-device mesh spanning both processes, and runs a psum across
 it — proving the mesh helpers are process-count-agnostic in fact.
 """
 
+import pytest
 import os
 import socket
 import subprocess
@@ -136,6 +137,7 @@ def _spawn_two(worker: str, port: int):
     return procs, outs
 
 
+@pytest.mark.slow  # tier-2: same machinery pinned faster elsewhere (suite-time budget, r4 verdict #8c)
 def test_two_process_fsdp_train_step():
     """An actual TRAINING step spanning two OS processes: the FSDP
     choreography (per-layer gathers, reduce-scatters, loss pmean) runs

@@ -145,7 +145,11 @@ MOE_CFG = dataclasses.replace(
     moe_group_size=32)
 
 
-@pytest.mark.parametrize("runner", [run_gpipe, run_1f1b])
+# GPipe and 1F1B share every stage kernel (fwd/bwd/last_fwd_bwd) and the
+# per-stage Adam; one schedule in the default suite pins the math, the
+# other rides the slow tier (r4 verdict: suite-time budget).
+@pytest.mark.parametrize("runner", [
+    pytest.param(run_gpipe, marks=pytest.mark.slow), run_1f1b])
 def test_moe_pipeline_matches_monolithic(runner):
     """MoE×PP: the per-stage aux-loss threading must reproduce the
     monolithic MoE step — loss (lm + weighted balance aux) AND updated
@@ -191,7 +195,8 @@ def test_moe_pipeline_matches_monolithic(runner):
     assert lo == MOE_CFG.num_hidden_layers
 
 
-@pytest.mark.parametrize("runner", [run_gpipe, run_1f1b])
+@pytest.mark.parametrize("runner", [
+    pytest.param(run_gpipe, marks=pytest.mark.slow), run_1f1b])
 def test_moe_pipeline_three_stages_multi_device(runner):
     """3+ stages on DISTINCT devices: the aux terms live on different
     stage devices and must aggregate on host (regression: jnp.stack of
