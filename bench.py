@@ -127,6 +127,16 @@ KNOB_MATRIX = [
     # OOM margins that killed the save_dots×int8 crossings.  Rows: the
     # current champion with s8 (is the q8 update's extra work free?),
     # and the previously-OOM crossings retried inside the freed room.
+    # MEASURED OUTCOME (r5, v5e-16GB): s8×b4x = 126.22 TFLOPS — the
+    # NEW knob-space ceiling (beats int8_bwd_b4x's 125.74 this run /
+    # 125.98 r4).  But at-rest savings ≠ in-step savings: adam8's
+    # update math runs in fp32, so its per-leaf temporaries RAISE the
+    # in-step peak — s8_b8x OOMs (19.9 GB) where plain b8 fit, and
+    # every save_dots×s8 crossing re-OOMs at the same or higher plan
+    # than its bf16-state twin.  The freed 1.6 GB is real at rest
+    # (pipeline stages use it via --opt8: 620M-param stages fit only
+    # with s8) — it just cannot be spent on knobs whose wall is the
+    # in-step activation peak.
     ("explicit_int8_bwd_s8_b4x", {"matmul_precision": "int8_bwd"},
      {"reshard_after_forward": True, "state_precision": "int8"}, 4),
     ("explicit_int8_bwd_s8_b8x", {"matmul_precision": "int8_bwd"},

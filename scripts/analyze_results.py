@@ -34,6 +34,9 @@ def _load_json_rows(dirname: str, pattern: str = "*.json") -> list[dict]:
         d = json.load(open(f))
         if isinstance(d, dict) and "rows" in d:   # wrapped artifact
             d = d["rows"]
+        for r in (d if isinstance(d, list) else [d]):
+            if isinstance(r, dict):
+                r.setdefault("_file", Path(f).stem)
         rows.extend(d if isinstance(d, list) else [d])
     return rows
 
@@ -279,10 +282,10 @@ def load_pp(dirname: str) -> list[dict]:
 def pp_table(rows: list[dict]) -> str:
     if not rows:
         return "_no pp result JSONs found_\n"
-    out = ["| schedule | stages | micro | final loss | avg epoch s | "
+    out = ["| run | schedule | stages | micro | final loss | avg epoch s | "
            "epochs/s | mem/stage MB | max stored acts | "
            "act MB/microbatch | bubble |",
-           "|---|---|---|---|---|---|---|---|---|---|"]
+           "|---|---|---|---|---|---|---|---|---|---|---|"]
     for r in rows:
         # allocator peaks when available, else the compile-time plan
         # (memory_source tags which; this substrate exposes no runtime
@@ -300,6 +303,7 @@ def pp_table(rows: list[dict]) -> str:
         if stats.get("v"):
             stages = (f"{stats['n_devices']}dev×{stats['v']}v")
         out.append(
+            f"| {r.get('_file', '—')} "
             f"| {r['schedule']} | {stages} | {r.get('n_micro') or '—'} | "
             f"{r['final_loss']:.4f} | "
             f"{r['avg_epoch_time_s']:.3f} | {r['epochs_per_s']:.2f} | "

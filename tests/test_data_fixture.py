@@ -122,3 +122,24 @@ def test_fixture_corpus_trains_tiny_lm(mesh8):
     assert len(losses) > 20
     first, last = np.mean(losses[:3]), np.mean(losses[-3:])
     assert last < first - 1.0, (first, last)
+
+
+def test_committed_corpus_tokenizes():
+    """The 8 MB real-text corpus + vocab-8192 tokenizer committed under
+    data/corpus/ (scripts/make_corpus.py) load through the same
+    tokenize→EOS→concat path as TinyStories; ids stay inside the vocab
+    the corpus geometries declare."""
+    from pathlib import Path
+
+    from distributed_training_sandbox_tpu.data.packing import (
+        get_corpus_tokens)
+    from distributed_training_sandbox_tpu.models import transformer as T
+
+    root = Path(__file__).resolve().parent.parent / "data" / "corpus"
+    assert (root / "docstrings.txt").stat().st_size > 4_000_000
+    stream = get_corpus_tokens(root / "docstrings.txt",
+                               tokenizer_file=root / "tokenizer.json",
+                               max_docs=60)
+    assert len(stream) > 2_000
+    assert 0 <= stream.min() and stream.max() < T.CORPUS_LM.vocab_size
+    assert T.CORPUS_350M.vocab_size == T.CORPUS_LM.vocab_size
