@@ -470,9 +470,19 @@ def make_moe_lm_train_step(
                                  is_leaf=lambda x: isinstance(x, P))
         with scope("opt_step"):
             shards, opt_state = optim.adam_update(
-                grads, opt_state, shards, lr=lr, b1=b1, b2=b2, eps=eps)
+                grads, opt_state, shards, lr=lr, b1=b1, b2=b2, eps=eps,
+                lr_mults=lr_mults)
         return shards, opt_state, loss
 
+    # router LR multiplier (cfg.moe_router_lr_mult): per-leaf LR tree —
+    # the same router-health knob the FSDP step honors
+    lr_mults = None
+    if getattr(cfg, "moe_router_lr_mult", 1.0) != 1.0:
+        lr_mults = jax.tree_util.tree_map_with_path(
+            lambda path, _leaf: (cfg.moe_router_lr_mult
+                                 if any(getattr(k, "key", None) == "w_router"
+                                        for k in path) else 1.0),
+            params_sharded)
     state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
     batch_spec = (P((dp_axis, ep_axis)) if sp_axis is None
                   else P((dp_axis, ep_axis), sp_axis))

@@ -31,6 +31,12 @@ def main(argv=None):
                    help="experts per token (1 = Switch, 2 = GShard "
                         "top-2 with normalized gates)")
     p.add_argument("--capacity-factor", type=float, default=2.0)
+    p.add_argument("--z-weight", type=float, default=0.0,
+                   help="router z-loss weight (ST-MoE) — the r5 corpus "
+                        "A/B's router-collapse fix (keeps drop rates "
+                        "single-digit at aux 0.01)")
+    p.add_argument("--router-lr-mult", type=float, default=1.0,
+                   help="LR multiplier on w_router leaves")
     args, rest = p.parse_known_args(argv)
 
     if args.cpu_devices:
@@ -65,7 +71,9 @@ def main(argv=None):
     mcfg = dataclasses.replace(
         base, n_experts=args.experts,
         moe_ffn=args.moe_ffn or max(base.intermediate_size // 4, 8),
-        moe_top_k=args.top_k, moe_capacity_factor=args.capacity_factor)
+        moe_top_k=args.top_k, moe_capacity_factor=args.capacity_factor,
+        moe_router_z_weight=args.z_weight,
+        moe_router_lr_mult=args.router_lr_mult)
     # consume the shared --precision knob (int8 variants quantize the
     # attention projections AND the per-expert MLP matmuls)
     if cfg.precision.startswith("int8"):
