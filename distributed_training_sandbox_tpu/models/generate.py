@@ -340,7 +340,8 @@ def _decode_cfg(cfg: T.TransformerConfig) -> T.TransformerConfig:
 
 
 def make_tp_generate(cfg: T.TransformerConfig, mesh, *, axis: str = "tp",
-                     max_new_tokens: int = 32, temperature: float = 0.0):
+                     max_new_tokens: int = 32, temperature: float = 0.0,
+                     kv_quant: bool = False):
     """TP-sharded decode: ``fn(params_tp, prompt_ids, rng) -> tokens``.
 
     ``params_tp`` hold Megatron layer shards
@@ -358,7 +359,8 @@ def make_tp_generate(cfg: T.TransformerConfig, mesh, *, axis: str = "tp",
 
     def core(params, prompt_ids, rng):
         return _generate_core(params, prompt_ids, rng, cfg,
-                              max_new_tokens, temperature, tp_axis=axis)
+                              max_new_tokens, temperature, tp_axis=axis,
+                              kv_quant=kv_quant)
 
     compiled = {}   # built once on first call (specs need a params tree)
 

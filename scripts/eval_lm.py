@@ -1,0 +1,121 @@
+"""Held-out evaluation of a trained LM: loss + perplexity over a packed
+text stream, fresh-initialized or restored from an Orbax checkpoint.
+
+Completes the train → checkpoint → eval → decode lifecycle (the
+reference course trains and benchmarks but never evaluates a saved
+model; a framework a user switches TO needs the other half, like the
+decode face in ``models/generate.py``).
+
+  * ``--data corpus`` evaluates on the committed real-text corpus
+    (``data/corpus/``) with a held-out TAIL split (the last
+    ``--holdout-frac`` of windows — the training scripts iterate from
+    the front, so the tail is the natural untouched slice);
+  * ``--ckpt-dir`` restores ``{"params": ...}`` (and ignores any opt
+    state) from the newest step of an Orbax checkpoint manager run
+    written by ``utils.checkpoint.save_state``;
+  * prints one JSON line: eval loss, perplexity, tokens, steps.
+
+    python scripts/eval_lm.py --model corpus-350m --data corpus \
+        --ckpt-dir runs/flagship/ckpt
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_training_sandbox_tpu.models import MODEL_REGISTRY  # noqa: E402
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", choices=sorted(MODEL_REGISTRY),
+                   default="corpus-350m")
+    p.add_argument("--data", choices=["synthetic", "corpus"],
+                   default="corpus")
+    p.add_argument("--sequence-length", type=int, default=2048)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--holdout-frac", type=float, default=0.05,
+                   help="tail fraction of the packed windows to score")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="Orbax checkpoint dir (newest step restored); "
+                        "default scores the fresh init — the baseline "
+                        "number a training run must beat")
+    p.add_argument("--precision", default="bf16")
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--out-file", default=None)
+    args = p.parse_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from distributed_training_sandbox_tpu.data import (
+        make_packed_dataset, packed_batches)
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.utils import set_seed
+
+    mcfg = getattr(T, MODEL_REGISTRY[args.model])
+    if args.precision.startswith("int8"):
+        mcfg = dataclasses.replace(mcfg, matmul_precision=args.precision)
+    mcfg = dataclasses.replace(
+        mcfg, attention_impl=("flash" if jax.default_backend() == "tpu"
+                              else "xla"))
+    seq, bs = args.sequence_length, args.batch_size
+
+    if args.data == "corpus":
+        root = Path(__file__).resolve().parent.parent
+        ii, ll = make_packed_dataset(
+            seq, mcfg.vocab_size, source="corpus",
+            corpus_path=root / "data" / "corpus" / "docstrings.txt",
+            tokenizer_file=root / "data" / "corpus" / "tokenizer.json")
+    else:
+        ii, ll = make_packed_dataset(seq, mcfg.vocab_size,
+                                     num_tokens=64 * bs * (seq + 1),
+                                     source="synthetic")
+    n_hold = max(int(len(ii) * args.holdout_frac), bs)
+    ii, ll = ii[-n_hold:], ll[-n_hold:]
+    print(f"[eval] holdout: {len(ii)} windows × seq {seq}")
+
+    params = T.init_params(set_seed(42), mcfg)
+    restored_step = None
+    if args.ckpt_dir:
+        from distributed_training_sandbox_tpu.utils import checkpoint as C
+        mgr = C.checkpoint_manager(args.ckpt_dir)
+        restored_step = C.latest_step(mgr)
+        if restored_step is None:
+            raise SystemExit(f"no checkpoint steps in {args.ckpt_dir}")
+        state = C.restore_state(mgr, like={"params": params})
+        params = state["params"]
+        print(f"[eval] restored step {restored_step} from {args.ckpt_dir}")
+
+    loss_fn = jax.jit(lambda p, b: T.lm_loss(p, b, mcfg))
+    tot, steps = 0.0, 0
+    for ib, lb in packed_batches(ii, ll, bs):
+        tot += float(loss_fn(params, (jnp.asarray(ib), jnp.asarray(lb))))
+        steps += 1
+    loss = tot / max(steps, 1)
+    out = {
+        "model": args.model, "data": args.data, "sequence_length": seq,
+        "holdout_windows": len(ii), "eval_steps": steps,
+        "eval_tokens": steps * bs * seq,
+        "restored_step": restored_step,
+        "eval_loss": round(loss, 4),
+        "perplexity": round(float(np.exp(loss)), 2),
+    }
+    print(json.dumps(out))
+    if args.out_file:
+        Path(args.out_file).write_text(json.dumps(out, indent=1))
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -39,7 +39,8 @@ from distributed_training_sandbox_tpu.models import MODEL_REGISTRY  # noqa: E402
 
 def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
             warmup_steps: int, peak_lr: float, out_dir: Path,
-            tag_suffix: str = "", data: str = "synthetic") -> dict:
+            tag_suffix: str = "", data: str = "synthetic",
+            ckpt_dir: str | None = None) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -74,9 +75,14 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
             seq, mcfg.vocab_size, source="corpus",
             corpus_path=root / "data" / "corpus" / "docstrings.txt",
             tokenizer_file=root / "data" / "corpus" / "tokenizer.json")
+        # reserve the tail 5% as scripts/eval_lm.py's held-out split —
+        # multi-epoch runs would otherwise train on it
+        n_hold = max(int(len(ii) * 0.05), bs)
+        ii, ll = ii[:-n_hold], ll[:-n_hold]
         epochs = -(-num_steps * bs // max(len(ii), 1))
         print(f"[flagship] corpus: {len(ii)} windows x seq {seq} "
-              f"({epochs} epoch(s) for {num_steps} steps)")
+              f"(+{n_hold} held out; {epochs} epoch(s) for "
+              f"{num_steps} steps)")
     else:
         # fresh windows for every step (engine="native": the C++ sampler,
         # ~10x faster stream builds at this size)
@@ -102,6 +108,15 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
                   f"lr {lrs[-1]:.2e} ({times[-1]:.0f}s)", flush=True)
     dt = times[-1] - times[1] if len(times) > 2 else times[-1]
     tok_s = (len(losses) - 1) * bs * seq / dt if dt > 0 else 0.0
+
+    if ckpt_dir:
+        # final-state Orbax save: scripts/eval_lm.py restores it (the
+        # train -> checkpoint -> eval lifecycle)
+        from distributed_training_sandbox_tpu.utils import checkpoint as C
+        mgr = C.checkpoint_manager(ckpt_dir)
+        C.save_state(mgr, len(losses), {"params": shards})
+        mgr.wait_until_finished()
+        print(f"[flagship] checkpoint step {len(losses)} -> {ckpt_dir}")
 
     warm = f"warm{warmup_steps}" if warmup_steps else "nowarm"
     corp = "_corpus" if data == "corpus" else ""
@@ -167,6 +182,9 @@ def main(argv=None):
                    default="synthetic",
                    help="'corpus' = the committed real-text corpus "
                         "(vocab 8192 — pair with a corpus-* model)")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="save the final params as an Orbax checkpoint "
+                        "(scripts/eval_lm.py restores it)")
     p.add_argument("--cpu-devices", type=int, default=0)
     p.add_argument("--out-dir", default="flagship_results")
     p.add_argument("--plot", default="plots/flagship_loss.png")
@@ -183,7 +201,8 @@ def main(argv=None):
                 data=args.data)
     run_leg(args.model, args.precision, args.sequence_length,
             args.batch_size, args.num_steps, args.warmup_steps,
-            args.peak_lr, out_dir, data=args.data)
+            args.peak_lr, out_dir, data=args.data,
+            ckpt_dir=args.ckpt_dir)
     plot(out_dir, Path(args.plot))
 
 

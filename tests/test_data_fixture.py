@@ -143,3 +143,31 @@ def test_committed_corpus_tokenizes():
     assert len(stream) > 2_000
     assert 0 <= stream.min() and stream.max() < T.CORPUS_LM.vocab_size
     assert T.CORPUS_350M.vocab_size == T.CORPUS_LM.vocab_size
+
+
+@pytest.mark.slow  # corpus tokenize + eval loop, ~40 s
+def test_eval_lm_lifecycle_restores_and_scores(tmp_path):
+    """scripts/eval_lm.py: fresh-init perplexity is near-uniform; a
+    checkpoint written by utils.checkpoint restores into the eval and
+    scores differently — the train→checkpoint→eval lifecycle's seam."""
+    import jax
+    from scripts.eval_lm import main as eval_main
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.utils import checkpoint as C
+
+    init = eval_main(["--model", "corpus-70m", "--data", "corpus",
+                      "--sequence-length", "256", "--batch-size", "4",
+                      "--holdout-frac", "0.01"])
+    assert init["restored_step"] is None
+    assert init["perplexity"] > 1000          # untrained ≈ uniform
+
+    params = T.init_params(jax.random.PRNGKey(7), T.CORPUS_LM)
+    mgr = C.checkpoint_manager(tmp_path / "ck")
+    C.save_state(mgr, 5, {"params": params})
+    mgr.wait_until_finished()
+    restored = eval_main(["--model", "corpus-70m", "--data", "corpus",
+                          "--sequence-length", "256", "--batch-size", "4",
+                          "--holdout-frac", "0.01",
+                          "--ckpt-dir", str(tmp_path / "ck")])
+    assert restored["restored_step"] == 5
+    assert restored["eval_loss"] != init["eval_loss"]
