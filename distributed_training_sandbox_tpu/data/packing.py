@@ -114,9 +114,7 @@ def get_corpus_tokens(corpus_path, *,
     ``tokenizer.json`` (``transformers.PreTrainedTokenizerFast``);
     ``tokenizer_name`` falls back to ``AutoTokenizer`` (cached/hub)."""
     if tokenizer_file is not None:
-        from transformers import PreTrainedTokenizerFast
-        tok = PreTrainedTokenizerFast(tokenizer_file=str(tokenizer_file),
-                                      eos_token="<eos>", unk_token="<unk>")
+        tok = load_corpus_tokenizer(tokenizer_file)
     elif tokenizer_name is not None:
         from transformers import AutoTokenizer
         tok = AutoTokenizer.from_pretrained(tokenizer_name)
@@ -225,3 +223,13 @@ def packed_batches(input_ids: np.ndarray, labels: np.ndarray,
         for i in range(0, n - (batch_size - 1 if drop_last else 0),
                        batch_size):
             yield input_ids[i:i + batch_size], labels[i:i + batch_size]
+
+
+def load_corpus_tokenizer(tokenizer_file):
+    """The committed corpus tokenizer as a HF-fast tokenizer — ONE place
+    configures its special tokens, shared by the data path
+    (``get_corpus_tokens``) and the decode-side scripts (detokenizing
+    generated ids must use the exact training-tokenizer config)."""
+    from transformers import PreTrainedTokenizerFast
+    return PreTrainedTokenizerFast(tokenizer_file=str(tokenizer_file),
+                                   eos_token="<eos>", unk_token="<unk>")

@@ -74,3 +74,17 @@ def restore_state(mgr: "ocp.CheckpointManager", *, like: Any,
     ocp = _ocp()
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
     return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+
+def restore_params(ckpt_dir, params):
+    """Convenience for the eval/demo scripts: open ``ckpt_dir``, restore
+    the newest step's ``{"params": ...}`` into ``params``' structure and
+    shardings, and return ``(restored_params, step)``.  Raises
+    SystemExit with a readable message when the directory holds no
+    steps (the CLI-facing contract both scripts share)."""
+    mgr = checkpoint_manager(ckpt_dir)
+    step = latest_step(mgr)
+    if step is None:
+        raise SystemExit(f"no checkpoint steps in {ckpt_dir}")
+    state = restore_state(mgr, like={"params": params})
+    return state["params"], step

@@ -88,13 +88,9 @@ def main(argv=None):
     params = T.init_params(set_seed(42), mcfg)
     restored_step = None
     if args.ckpt_dir:
-        from distributed_training_sandbox_tpu.utils import checkpoint as C
-        mgr = C.checkpoint_manager(args.ckpt_dir)
-        restored_step = C.latest_step(mgr)
-        if restored_step is None:
-            raise SystemExit(f"no checkpoint steps in {args.ckpt_dir}")
-        state = C.restore_state(mgr, like={"params": params})
-        params = state["params"]
+        from distributed_training_sandbox_tpu.utils.checkpoint import (
+            restore_params)
+        params, restored_step = restore_params(args.ckpt_dir, params)
         print(f"[eval] restored step {restored_step} from {args.ckpt_dir}")
 
     loss_fn = jax.jit(lambda p, b: T.lm_loss(p, b, mcfg))

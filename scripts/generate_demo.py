@@ -9,7 +9,8 @@ a framework" artifact (train → checkpoint → decode → detokenize).
         --prompt "Returns the" --out-file data_results/generate_demo.json
 
 Greedy and temperature samples are both emitted; the committed artifact
-records the prompt, the token ids, and the detokenized continuations.
+records the prompt, each sample's token ids, and the detokenized
+continuations.
 """
 
 from __future__ import annotations
@@ -43,54 +44,59 @@ def main(argv=None):
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(args.cpu_devices)
 
+    if args.temperature <= 0.0:
+        raise SystemExit("--temperature must be > 0: the sampled "
+                         "entries would silently duplicate the greedy "
+                         "chain (greedy is always emitted anyway)")
+
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from transformers import PreTrainedTokenizerFast
+    from distributed_training_sandbox_tpu.data.packing import (
+        load_corpus_tokenizer)
     from distributed_training_sandbox_tpu.models import transformer as T
     from distributed_training_sandbox_tpu.models.generate import (
         generate, quantize_decode_params)
-    from distributed_training_sandbox_tpu.utils import checkpoint as C
+    from distributed_training_sandbox_tpu.utils.checkpoint import (
+        restore_params)
     from distributed_training_sandbox_tpu.utils import set_seed
 
     root = Path(__file__).resolve().parent.parent
-    tok = PreTrainedTokenizerFast(
-        tokenizer_file=str(root / "data" / "corpus" / "tokenizer.json"),
-        eos_token="<eos>", unk_token="<unk>")
+    tok = load_corpus_tokenizer(root / "data" / "corpus" / "tokenizer.json")
 
     mcfg = getattr(T, MODEL_REGISTRY[args.model])
     mcfg = dataclasses.replace(
         mcfg, attention_impl=("flash" if jax.default_backend() == "tpu"
                               else "xla"))
     params = T.init_params(set_seed(42), mcfg)
-    mgr = C.checkpoint_manager(args.ckpt_dir)
-    step = C.latest_step(mgr)
-    if step is None:
-        raise SystemExit(f"no checkpoint steps in {args.ckpt_dir}")
-    params = C.restore_state(mgr, like={"params": params})["params"]
+    params, step = restore_params(args.ckpt_dir, params)
     print(f"[demo] restored step {step} from {args.ckpt_dir}")
     if args.int8:
         params = quantize_decode_params(params, mcfg)
 
     ids = tok(args.prompt)["input_ids"]
     prompt_ids = jnp.asarray([ids], jnp.int32)
-    samples = {}
-    greedy = np.asarray(generate(
+    samples, sample_ids = {}, {}
+
+    def record(name, toks):
+        sample_ids[name] = np.asarray(toks).tolist()
+        samples[name] = tok.decode(sample_ids[name])
+
+    record("greedy", np.asarray(generate(
         params, prompt_ids, mcfg,
-        max_new_tokens=args.max_new_tokens))[0]
-    samples["greedy"] = tok.decode(greedy.tolist())
+        max_new_tokens=args.max_new_tokens))[0])
     for i in range(2):
-        s = np.asarray(generate(
-            params, prompt_ids, mcfg,
-            max_new_tokens=args.max_new_tokens,
-            temperature=args.temperature,
-            rng=jax.random.PRNGKey(100 + i)))[0]
-        samples[f"t{args.temperature:g}_seed{100 + i}"] = \
-            tok.decode(s.tolist())
+        record(f"t{args.temperature:g}_seed{100 + i}",
+               np.asarray(generate(
+                   params, prompt_ids, mcfg,
+                   max_new_tokens=args.max_new_tokens,
+                   temperature=args.temperature,
+                   rng=jax.random.PRNGKey(100 + i)))[0])
 
     out = {"model": args.model, "restored_step": step,
-           "prompt": args.prompt, "int8": args.int8,
-           "max_new_tokens": args.max_new_tokens, "samples": samples}
+           "prompt": args.prompt, "prompt_ids": ids, "int8": args.int8,
+           "max_new_tokens": args.max_new_tokens, "samples": samples,
+           "sample_ids": sample_ids}
     print(json.dumps(out, indent=1))
     if args.out_file:
         Path(args.out_file).write_text(json.dumps(out, indent=1))
