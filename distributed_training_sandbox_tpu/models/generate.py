@@ -43,8 +43,8 @@ from . import transformer as T
 
 
 class KVCache(NamedTuple):
-    """Per-layer cache buffers (tuples of L arrays, each
-    (B, S_max, n_kv, hd)) rather than one stacked (L, ...) array: the
+    """Per-layer cache buffers (tuples of L arrays, each HEAD-MAJOR
+    (B, n_kv, S_max, hd)) rather than one stacked (L, ...) array: the
     stacked layout made every decode step pay a dynamic-slice COPY of
     each layer's cache (indexing ``cache.k[li]`` inside the layer scan)
     plus a full re-stack into the scan's ys — ~3× the unavoidable
@@ -52,15 +52,18 @@ class KVCache(NamedTuple):
     roofline at prompt 2048).  With per-layer buffers the layer loop is
     unrolled (static layer index), ``dynamic_update_slice`` writes only
     the new token column in place, and the attention einsum reads the
-    buffer directly.
+    buffer directly.  HEAD-major (heads before positions) matches the
+    attention dot's batch-dim layout — position-major made XLA
+    materialize a transposed copy of the whole cache every step (the
+    residual bf16 long-prompt gap after the per-layer rewrite).
 
-    ``k_scale``/``v_scale``: per-(batch, position, head) fp32 absmax
+    ``k_scale``/``v_scale``: per-(batch, head, position) fp32 absmax
     scales when the cache is stored int8 (``quantized=True``) — half the
     cache-read bytes, the decode twin of the int8 weight path; None for
     the bf16 cache."""
-    k: tuple          # L × (B, S_max, n_kv, hd) cfg.dtype or int8
-    v: tuple          # L × (B, S_max, n_kv, hd)
-    k_scale: tuple | None   # L × (B, S_max, n_kv, 1) f32 (int8 only)
+    k: tuple          # L × (B, n_kv, S_max, hd) cfg.dtype or int8
+    v: tuple          # L × (B, n_kv, S_max, hd)
+    k_scale: tuple | None   # L × (B, n_kv, S_max, 1) f32 (int8 only)
     v_scale: tuple | None
     length: jax.Array  # () int32 — tokens currently cached
 
@@ -73,7 +76,7 @@ def init_cache(cfg: T.TransformerConfig, batch: int,
     both shrink by tp, the point of TP-sharded decode)."""
     L, nkv, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
                   cfg.resolved_head_dim)
-    shape = (batch, max_len, nkv // tp, hd)
+    shape = (batch, nkv // tp, max_len, hd)
     dt = jnp.int8 if quantized else cfg.dtype
     zeros = lambda: tuple(jnp.zeros(shape, dt) for _ in range(L))
     scales = lambda: (tuple(jnp.ones(shape[:-1] + (1,), jnp.float32)
@@ -148,18 +151,22 @@ def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope,
     r = T.rms_norm(x, layer["ln1"], cfg.rms_norm_eps)
     q, k, v = T._qkv_proj(r, layer, cfg=cfg, cos=cos, sin=sin,
                           use_rope=use_rope, tp=tp)
+    # head-major like the cache: (B, S, n_kv, hd) -> (B, n_kv, S, hd) —
+    # a tiny S-token transpose instead of a whole-cache one per step
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
 
     quantized = ck.dtype == jnp.int8
     if quantized:
         kq, ks_new = _quant_kv(k)
         vq, vs_new = _quant_kv(v)
-        ck = lax.dynamic_update_slice(ck, kq, (0, start, 0, 0))
-        cv = lax.dynamic_update_slice(cv, vq, (0, start, 0, 0))
-        ck_s = lax.dynamic_update_slice(ck_s, ks_new, (0, start, 0, 0))
-        cv_s = lax.dynamic_update_slice(cv_s, vs_new, (0, start, 0, 0))
+        ck = lax.dynamic_update_slice(ck, kq, (0, 0, start, 0))
+        cv = lax.dynamic_update_slice(cv, vq, (0, 0, start, 0))
+        ck_s = lax.dynamic_update_slice(ck_s, ks_new, (0, 0, start, 0))
+        cv_s = lax.dynamic_update_slice(cv_s, vs_new, (0, 0, start, 0))
     else:
-        ck = lax.dynamic_update_slice(ck, k, (0, start, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v, (0, start, 0, 0))
+        ck = lax.dynamic_update_slice(ck, k, (0, 0, start, 0))
+        cv = lax.dynamic_update_slice(cv, v, (0, 0, start, 0))
 
     # attention over the cache: visible = pos_kv <= pos_q (absolute).
     # GQA reads the cache DIRECTLY — grouping the q heads per kv head
@@ -174,19 +181,26 @@ def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope,
     # contracted dim — multiplies the score afterwards, so the HBM read
     # really is int8; the V side folds its scale into the fp32 PV
     # accumulation the same way.
-    S_max = ck.shape[1]
+    S_max = ck.shape[2]
     rep = nq // nkv
     qg = q.reshape(B, S, nkv, rep, hd)
     if quantized:
-        scores = jnp.einsum(
-            "bsgrh,bkgh->bgrsk", qg.astype(jnp.float32),
-            ck.astype(jnp.float32),
-            preferred_element_type=jnp.float32) / math.sqrt(hd)
-        # fold the K row scales over the cache-position axis k
-        scores = scores * ck_s[..., 0].transpose(0, 2, 1)[:, :, None, None, :]
+        # TRUE int8 attention: quantize q per row too and contract the
+        # int8 CODES on the MXU with int32 accumulation — the cache is
+        # read raw (half the bytes), no fp32 upcast copy of it (the
+        # upcast-then-dot variant measured SLOWER than the bf16 cache
+        # at prompt 2048).  Scales fold outside the contraction: the K
+        # row scale is constant over the contracted hd axis, so it
+        # multiplies the score afterwards.
+        qq, q_s = _quant_kv(qg)                       # rows over hd
+        scores_i = jnp.einsum("bsgrh,bgkh->bgrsk", qq, ck,
+                              preferred_element_type=jnp.int32)
+        scores = (scores_i.astype(jnp.float32)
+                  * q_s[..., 0].transpose(0, 2, 3, 1)[..., None]
+                  * ck_s[..., 0][:, :, None, None, :]) / math.sqrt(hd)
     else:
         scores = jnp.einsum(
-            "bsgrh,bkgh->bgrsk", qg, ck,
+            "bsgrh,bgkh->bgrsk", qg, ck,
             preferred_element_type=jnp.float32) / math.sqrt(hd)
     pos_q = start + jnp.arange(S)
     pos_kv = jnp.arange(S_max)
@@ -194,13 +208,17 @@ def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope,
     scores = jnp.where(vis[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     if quantized:
-        # weight probs by the V row scales, contract int8 codes in fp32
-        pv = probs * cv_s[..., 0].transpose(0, 2, 1)[:, :, None, None, :]
-        attn = jnp.einsum("bgrsk,bkgh->bsgrh", pv,
-                          cv.astype(jnp.float32),
-                          preferred_element_type=jnp.float32)
+        # fold the per-POSITION V scales into probs (they vary along
+        # the contracted axis k), then row-quantize the weighted probs
+        # so the V dot also runs int8 × int8 over the raw cache
+        pv = probs * cv_s[..., 0][:, :, None, None, :]
+        pvq, pv_s = _quant_kv(pv)                     # rows over k
+        attn_i = jnp.einsum("bgrsk,bgkh->bsgrh", pvq, cv,
+                            preferred_element_type=jnp.int32)
+        attn = attn_i.astype(jnp.float32) \
+            * pv_s[..., 0].transpose(0, 3, 1, 2)[..., None]
     else:
-        attn = jnp.einsum("bgrsk,bkgh->bsgrh", probs.astype(x.dtype), cv,
+        attn = jnp.einsum("bgrsk,bgkh->bsgrh", probs.astype(x.dtype), cv,
                           preferred_element_type=jnp.float32)
     attn = attn.astype(x.dtype).reshape(B, S, nq * hd)
     attn_out = dense(attn, layer["wo"])

@@ -83,7 +83,10 @@ def run_one(cfg, params, precision: str, batch: int, prompt_len: int,
                             kv_quant=kv_quant))
     p2 = jnp.roll(prompt, 1, axis=1)
     tH = tN = float("inf")
-    for _ in range(2):                   # best-of-2 per point
+    for _ in range(3):                   # best-of-3 per point — a single
+        # slow tH sample makes the difference quotient read IMPOSSIBLY
+        # fast (one sweep recorded 1.49× the byte floor from exactly
+        # this; re-measured stable at 0.83)
         t0 = time.perf_counter()
         np.asarray(generate(params, p2, cfg, max_new_tokens=n_half,
                             kv_quant=kv_quant))

@@ -173,9 +173,10 @@ def test_tp_decode_cache_is_sharded(mesh2x4):
     cfg = T.TINY_LM
     c2 = init_cache(cfg, 2, 16, tp=2)
     c1 = init_cache(cfg, 2, 16)
-    # per-layer buffers (B, S_max, n_kv, hd): head dim is axis 2
+    # per-layer HEAD-MAJOR buffers (B, n_kv, S_max, hd): head dim is
+    # axis 1
     assert len(c1.k) == cfg.num_hidden_layers
-    assert c2.k[0].shape[2] == c1.k[0].shape[2] // 2
+    assert c2.k[0].shape[1] == c1.k[0].shape[1] // 2
 
 
 def test_kv_quant_decode_tracks_bf16_decode():
