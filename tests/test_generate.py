@@ -222,3 +222,28 @@ def test_tp_kv_quant_decode_tracks_single_device(mesh2x4):
     # which heads share a scale — demand high agreement, identical start
     assert (got == want).mean() >= 0.9
     np.testing.assert_array_equal(got[:, 0], want[:, 0])
+
+
+def test_tp_moe_decode_matches_single_device(mesh2x4):
+    """MoE × TP decode: Megatron-split experts (F-dim shards via
+    tp_specs) under the cached decode path reproduce the single-device
+    token chain exactly — the composition falls out of the shared
+    _mlp_block + spec machinery, pinned here so it stays true."""
+    from jax.sharding import Mesh
+    from distributed_training_sandbox_tpu.models.generate import (
+        make_tp_generate)
+    from distributed_training_sandbox_tpu.parallel.tensor import (
+        shard_params_tp)
+
+    cfg = dataclasses.replace(T.TINY_LM, n_experts=4, moe_ffn=32,
+                              moe_capacity_factor=8.0)
+    tp_mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(1, 2),
+                   ("dp", "tp"))
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0,
+                                cfg.vocab_size)
+    want = np.asarray(generate(params, prompt, cfg, max_new_tokens=6))
+    tp = shard_params_tp(params, tp_mesh)
+    got = np.asarray(make_tp_generate(cfg, tp_mesh,
+                                      max_new_tokens=6)(tp, prompt))
+    np.testing.assert_array_equal(got, want)
