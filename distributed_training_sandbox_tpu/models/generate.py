@@ -5,9 +5,10 @@ The reference is a training course and never decodes (its models run
 with ``use_cache=False``, ``fsdp/train_fsdp.py:61-64``); a framework a
 user can switch to needs the other half.  TPU-shaped design:
 
-  * the cache is a fixed-capacity pytree ``(L, B, S_max, n_kv, hd)`` —
-    static shapes end to end, so the whole decode loop is ONE compiled
-    ``lax.scan`` (no per-token retrace, no dynamic shapes);
+  * the cache is a fixed-capacity pytree of per-layer HEAD-MAJOR
+    ``(B, n_kv, S_max, hd)`` buffers — static shapes end to end, so the
+    whole decode loop is ONE compiled ``lax.scan`` (no per-token
+    retrace, no dynamic shapes);
   * prefill = the normal batched forward (MXU-friendly) that also
     writes the cache via ``lax.dynamic_update_slice``;
   * decode steps run single-query attention against the cache with a
@@ -116,9 +117,11 @@ def quantize_decode_params(params: dict, cfg: T.TransformerConfig) -> dict:
 
 
 def _quant_kv(t):
-    """(B, S, n_kv, hd) bf16 → (int8, (B, S, n_kv, 1) f32 scales):
-    per-(batch, position, head) row quantization over hd — the shared
-    symmetric absmax quantizer (``ops.quant.quantize_int8``)."""
+    """Row quantization over the LAST axis: ``(..., D)`` →
+    ``(int8 (..., D), f32 (..., 1) scales)`` via the shared symmetric
+    absmax quantizer (``ops.quant.quantize_int8``).  Used on head-major
+    K/V tensors (rows over hd), on q (rows over hd), and on the
+    v-scaled probs (rows over the cache-position axis)."""
     from ..ops.quant import quantize_int8
     return quantize_int8(t, axis=-1)
 
@@ -130,11 +133,13 @@ def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope,
     (``transformer._qkv_proj`` / ``_mlp_block`` — one implementation, no
     drift) with attention run against [0, start + S) of the cache
     instead of the local chunk.  x: (B, S, H) with S = prefill length
-    or 1.  ``ck``/``cv`` are THIS layer's (B, S_max, n_kv, hd) buffers;
-    ``ck_s``/``cv_s`` their int8 row scales or None — updates are
-    single in-place ``dynamic_update_slice`` writes of the new token
-    column (the stacked-(L, ...) layout's per-step slice copy + restack
-    was the r4 long-prompt decode gap).
+    or 1.  ``ck``/``cv`` are THIS layer's HEAD-MAJOR
+    (B, n_kv, S_max, hd) buffers; ``ck_s``/``cv_s`` their
+    (B, n_kv, S_max, 1) int8 row scales or None — updates are single
+    in-place ``dynamic_update_slice`` writes of the new token column
+    (the stacked-(L, ...) layout's per-step slice copy + restack was
+    the r4 long-prompt decode gap; position-major additionally made
+    XLA transpose the whole cache for the attention dot each step).
 
     ``tp_axis``: Megatron tensor-parallel decode (shard_map only) —
     ``layer`` holds this rank's head/intermediate shards
