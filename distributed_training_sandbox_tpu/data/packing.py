@@ -233,3 +233,15 @@ def load_corpus_tokenizer(tokenizer_file):
     from transformers import PreTrainedTokenizerFast
     return PreTrainedTokenizerFast(tokenizer_file=str(tokenizer_file),
                                    eos_token="<eos>", unk_token="<unk>")
+
+
+def corpus_holdout_split(input_ids, labels, *, frac: float = 0.05,
+                         min_windows: int = 1):
+    """ONE definition of the corpus train/holdout split: the TAIL
+    ``frac`` of packed windows (≥ ``min_windows``) is held out.  Both
+    the trainer (which must NOT touch it) and the evaluator (which
+    scores exactly it) call this, so the two can never disagree about
+    where the boundary sits."""
+    n_hold = max(int(len(input_ids) * frac), min_windows)
+    return ((input_ids[:-n_hold], labels[:-n_hold]),
+            (input_ids[-n_hold:], labels[-n_hold:]))

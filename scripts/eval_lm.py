@@ -81,8 +81,10 @@ def main(argv=None):
         ii, ll = make_packed_dataset(seq, mcfg.vocab_size,
                                      num_tokens=64 * bs * (seq + 1),
                                      source="synthetic")
-    n_hold = max(int(len(ii) * args.holdout_frac), bs)
-    ii, ll = ii[-n_hold:], ll[-n_hold:]
+    from distributed_training_sandbox_tpu.data.packing import (
+        corpus_holdout_split)
+    _, (ii, ll) = corpus_holdout_split(ii, ll, frac=args.holdout_frac,
+                                       min_windows=bs)
     print(f"[eval] holdout: {len(ii)} windows × seq {seq}")
 
     params = T.init_params(set_seed(42), mcfg)

@@ -75,13 +75,15 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
             seq, mcfg.vocab_size, source="corpus",
             corpus_path=root / "data" / "corpus" / "docstrings.txt",
             tokenizer_file=root / "data" / "corpus" / "tokenizer.json")
-        # reserve the tail 5% as scripts/eval_lm.py's held-out split —
-        # multi-epoch runs would otherwise train on it
-        n_hold = max(int(len(ii) * 0.05), bs)
-        ii, ll = ii[:-n_hold], ll[:-n_hold]
+        # reserve the tail as scripts/eval_lm.py's held-out split —
+        # multi-epoch runs would otherwise train on it; ONE shared
+        # definition of the boundary (data.packing.corpus_holdout_split)
+        from distributed_training_sandbox_tpu.data.packing import (
+            corpus_holdout_split)
+        (ii, ll), (hi, _) = corpus_holdout_split(ii, ll, min_windows=bs)
         epochs = -(-num_steps * bs // max(len(ii), 1))
         print(f"[flagship] corpus: {len(ii)} windows x seq {seq} "
-              f"(+{n_hold} held out; {epochs} epoch(s) for "
+              f"(+{len(hi)} held out; {epochs} epoch(s) for "
               f"{num_steps} steps)")
     else:
         # fresh windows for every step (engine="native": the C++ sampler,

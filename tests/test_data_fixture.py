@@ -171,3 +171,23 @@ def test_eval_lm_lifecycle_restores_and_scores(tmp_path):
                           "--ckpt-dir", str(tmp_path / "ck")])
     assert restored["restored_step"] == 5
     assert restored["eval_loss"] != init["eval_loss"]
+
+
+def test_corpus_holdout_split_is_disjoint_and_shared():
+    """The trainer's reserved tail == the evaluator's holdout, by
+    construction: one helper defines the boundary, splits are disjoint
+    and cover the stream."""
+    from distributed_training_sandbox_tpu.data.packing import (
+        corpus_holdout_split)
+
+    ii = np.arange(200).reshape(100, 2)
+    ll = ii + 1
+    (ti, tl), (hi, hl) = corpus_holdout_split(ii, ll, frac=0.05,
+                                              min_windows=4)
+    assert len(hi) == 5 and len(ti) == 95
+    np.testing.assert_array_equal(np.concatenate([ti, hi]), ii)
+    np.testing.assert_array_equal(np.concatenate([tl, hl]), ll)
+    # min_windows floor engages on tiny streams
+    (_, _), (h2, _) = corpus_holdout_split(ii[:10], ll[:10], frac=0.05,
+                                           min_windows=4)
+    assert len(h2) == 4
