@@ -197,3 +197,27 @@ def test_launcher_multiprocess_zero1(tmp_path):
     assert logs == ["worker_0.log", "worker_1.log"]
     w1 = (run_dirs[0] / "worker_1.log").read_text()
     assert "A/B report" in w1
+
+
+def test_multiprocess_early_abort_on_worker_failure(tmp_path):
+    """r4 advisor: if one worker dies during bring-up, the group must be
+    killed promptly instead of the survivors blocking in collectives
+    until the full timeout.  Worker 1 exits 1 immediately; worker 0
+    would sleep 300 s — the launcher must return rc!=0 in seconds."""
+    import os
+    import time
+
+    from distributed_training_sandbox_tpu.launch.launcher import (
+        LaunchConfig, _run_multiprocess)
+
+    cfg = LaunchConfig(device_spec="cpu:1", trace_root=tmp_path,
+                       timeout=300)
+    cmd = [sys.executable, "-c",
+           "import os,sys,time; "
+           "sys.exit(1) if os.environ['DTS_PROCESS_ID']=='1' "
+           "else time.sleep(300)"]
+    t0 = time.monotonic()
+    rc = _run_multiprocess(cfg, cmd, dict(os.environ), tmp_path, 2)
+    dt = time.monotonic() - t0
+    assert rc != 0
+    assert dt < 60, f"group not killed promptly ({dt:.0f}s)"
