@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.collectives import axis_size
+
 from . import transformer as T
 
 
@@ -149,7 +151,7 @@ def _cached_layer_body(x, layer, *, cfg, cos, sin, use_rope,
     the training layer uses (``transformer._layer_body``)."""
     B, S, H = x.shape
     hd = cfg.resolved_head_dim
-    tp = lax.axis_size(tp_axis) if tp_axis else 1
+    tp = axis_size(tp_axis) if tp_axis else 1
     nq, nkv = cfg.num_attention_heads // tp, cfg.num_key_value_heads // tp
     dense = T._dense(cfg)
 
@@ -295,7 +297,7 @@ def _generate_core(params, prompt_ids, rng, cfg: T.TransformerConfig,
                    tp_axis=None, kv_quant: bool = False):
     B, S0 = prompt_ids.shape
     S_max = S0 + max_new_tokens
-    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    tp = axis_size(tp_axis) if tp_axis else 1
     cache = init_cache(cfg, B, S_max, tp=tp, quantized=kv_quant)
     logits, cache = _forward_cached(params, prompt_ids, cfg, cache, 0,
                                     tp_axis=tp_axis)

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..ops.collectives import axis_size
+
 
 @dataclass(frozen=True)
 class TransformerConfig:
@@ -454,7 +456,7 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     outputs are psum'd back into the residual stream."""
     B, S, h = x.shape
     hd = cfg.resolved_head_dim
-    tp = lax.axis_size(tp_axis) if tp_axis else 1
+    tp = axis_size(tp_axis) if tp_axis else 1
     nq = cfg.num_attention_heads // tp
     dense = _dense(cfg)
 

@@ -51,7 +51,15 @@ def axis_rank(axis_name: str) -> jax.Array:
 
 
 def axis_size(axis_name: str) -> int:
-    return lax.axis_size(axis_name)
+    """Static size of the named mesh axis, usable inside shard_map/pmap.
+
+    ``lax.axis_size`` only exists on newer jax; older versions (this
+    substrate ships 0.4.x) get the classic ``psum(1, axis)`` trick, which
+    constant-folds to the same trace-time Python int — every call site
+    that uses the result as a shape/loop bound keeps working."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
 
 
 def all_reduce(x, axis_name: str, op: str = "sum", *, mean: bool = False):
@@ -80,7 +88,7 @@ def all_reduce(x, axis_name: str, op: str = "sum", *, mean: bool = False):
     if mean:
         if op != "sum":
             raise ValueError("mean only makes sense with sum")
-        out = out / lax.axis_size(axis_name)
+        out = out / axis_size(axis_name)
     return out
 
 
@@ -115,7 +123,7 @@ def scatter(x, axis_name: str, *, axis: int = 0):
     equal chunks, one per device.  SPMD formulation: every device slices its
     own chunk of the (already broadcast) input."""
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if x.shape[axis] % n:
         raise ValueError(f"scatter: dim {axis} of size {x.shape[axis]} not "
                          f"divisible by axis {axis_name!r} size {n}")
@@ -127,7 +135,7 @@ def ppermute_ring(x, axis_name: str, *, shift: int = 1):
     """Ring send/recv: device i sends to (i+shift) mod n — the twin of the
     reference's send/recv pairs (``02-operations.ipynb`` cells 11-21) and of
     pipeline stage hops."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 

@@ -369,7 +369,7 @@ def _qag_fwd(x, axis_name, axis):
     if x.ndim == 1:
         # 1-D leaf (e.g. a norm scale): one scalar scale per shard,
         # re-applied segment-wise after the gather.
-        ws = lax.axis_size(axis_name)
+        ws = C.axis_size(axis_name)
         n = x.shape[0]
         q, s = quantize_int8(x.reshape(1, n), axis=-1)  # s: (1, 1)
         qg = C.all_gather(q.reshape(n), axis_name, axis=0)       # (ws*n,)

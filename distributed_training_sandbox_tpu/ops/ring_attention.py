@@ -45,6 +45,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .collectives import axis_size
+
 _NEG_INF = -1e30
 
 
@@ -83,7 +85,7 @@ def ring_attention(q, k, v, axis_name: str, *, scale: float,
                             block_q=block_q)
     if layout != "contiguous":
         raise ValueError(f"unknown ring layout {layout!r}")
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Sq, nq, hd = q.shape
     nkv = k.shape[2]
@@ -186,7 +188,7 @@ def ring_attention(q, k, v, axis_name: str, *, scale: float,
 def zigzag_positions(axis_name: str, s_local: int) -> jax.Array:
     """Global token positions of this rank's zigzag chunk (stripe ``my``
     then stripe ``2D−1−my``) — what RoPE and the local causal mask see."""
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     w = s_local // 2
     ar = jnp.arange(w)
@@ -203,7 +205,7 @@ def _ring_zigzag(q, k, v, axis_name: str, *, scale: float,
     local block (t = 0) is one position-masked product over the whole
     chunk.  Accumulators (m, l, o) span the full local S and products
     read/write their stripe's half via static slices."""
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Sq, nq, hd = q.shape
     if Sq % 2:

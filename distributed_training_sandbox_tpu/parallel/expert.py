@@ -186,7 +186,7 @@ def moe_mlp(x, w_router, w_gate, w_up, w_down, *, axis: str | None = "ep",
     ahead of second choices — bursty seconds drop first).  top_k > 1
     requires the "grouped" dispatch.
     """
-    ep = lax.axis_size(axis) if axis else 1
+    ep = C.axis_size(axis) if axis else 1
     B, S, H = x.shape
     N = B * S
     E = w_router.shape[1]

@@ -96,7 +96,7 @@ def _pad_flat(x: jax.Array, ws: int) -> jax.Array:
 def local_chunk(full: jax.Array, axis: str) -> jax.Array:
     """This device's flat chunk of ``full`` (pad-to-ws then slice).  Pure
     data movement, no collective."""
-    ws = lax.axis_size(axis)
+    ws = C.axis_size(axis)
     idx = lax.axis_index(axis)
     flat = _pad_flat(full, ws)
     c = flat.size // ws
@@ -114,7 +114,7 @@ def rebuild_param(chunk: jax.Array, shape, size: int, axis: str,
     if mode == "all_gather":
         flat = C.all_gather(chunk, axis)
     elif mode == "broadcast":
-        ws = lax.axis_size(axis)
+        ws = C.axis_size(axis)
         idx = lax.axis_index(axis)
         padded = jnp.zeros((chunk.size * ws,), chunk.dtype)
         padded = lax.dynamic_update_slice(padded, chunk, (idx * chunk.size,))

@@ -1,0 +1,30 @@
+"""Unified run telemetry — the machine-readable observability layer the
+reference's per-script print lines never had.
+
+Every training script emits the same three artifacts under
+``<results_dir>/<run_id>/``:
+
+  * ``manifest.json``  — immutable startup facts (:class:`RunManifest`):
+    strategy, full ``TrainConfig``, mesh shape, device kind/count,
+    jax/jaxlib versions, git sha, compile-time HLO collective counts;
+  * ``steps.jsonl``    — one event per optimizer step under the shared
+    schema (:mod:`.schema`), fed by ``PerformanceTracker`` metrics;
+  * ``summary.json``   — end-of-run aggregates plus, when profiling was
+    on, the ``trace_analysis.split_from_trace`` comm/compute split and
+    the trace directory.
+
+``scripts/report.py`` reads these back for the cross-run side-by-side
+table and regression deltas — the ICI half of the NCCL-vs-ICI
+comparison in BASELINE.md.
+"""
+
+from .schema import STEP_SCHEMA_VERSION, step_event  # noqa: F401
+from .manifest import RunManifest  # noqa: F401
+from .writer import MetricsWriter  # noqa: F401
+from .run import TelemetryRun  # noqa: F401
+from .report import (  # noqa: F401
+    discover_runs,
+    load_baseline_rows,
+    render_table,
+    check_regressions,
+)

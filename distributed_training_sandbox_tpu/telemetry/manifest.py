@@ -1,0 +1,108 @@
+"""Run manifest: the immutable startup facts of one training run.
+
+Captured once before the step loop and never rewritten — everything a
+later reader needs to know *what* was run in order to trust the numbers
+in ``steps.jsonl``: strategy, the full ``TrainConfig``, mesh geometry,
+device kind/count, process topology, jax/jaxlib versions, git sha, and
+the compile-time HLO collective counts (``ops.hlo.count_collectives``)
+of the step function — the choreography fingerprint that lets the
+report CLI show "N all-reduces/step" next to step time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import os
+import subprocess
+from dataclasses import dataclass, field
+from typing import Any
+
+MANIFEST_SCHEMA_VERSION = 1
+
+
+def _git_sha() -> str | None:
+    """Best-effort checkout sha; None outside a git work tree."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5)
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _config_dict(config: Any) -> dict:
+    if config is None:
+        return {}
+    if dataclasses.is_dataclass(config) and not isinstance(config, type):
+        return dataclasses.asdict(config)
+    if isinstance(config, dict):
+        return dict(config)
+    return {"repr": repr(config)}
+
+
+@dataclass
+class RunManifest:
+    schema: int = MANIFEST_SCHEMA_VERSION
+    run_id: str = ""
+    strategy: str = ""
+    model: str | None = None
+    config: dict = field(default_factory=dict)
+    mesh_shape: dict = field(default_factory=dict)
+    mesh_axes: list = field(default_factory=list)
+    device_kind: str = ""
+    device_count: int = 0
+    local_device_count: int = 0
+    process_index: int = 0
+    process_count: int = 1
+    platform: str = ""
+    jax_version: str = ""
+    jaxlib_version: str | None = None
+    git_sha: str | None = None
+    started_utc: str = ""
+    collective_counts: dict | None = None
+    extra: dict = field(default_factory=dict)
+
+    @classmethod
+    def capture(cls, strategy: str, *, run_id: str = "",
+                config: Any = None, mesh=None, model: str | None = None,
+                collective_counts: dict | None = None,
+                extra: dict | None = None) -> "RunManifest":
+        """Snapshot the environment at step 0.  ``mesh`` is a
+        ``jax.sharding.Mesh`` (or None for meshless scripts);
+        ``collective_counts`` is the ``count_collectives`` dict the
+        scripts already compute for their startup print."""
+        import jax
+        dev = jax.devices()[0]
+        jaxlib_version = None
+        try:
+            import jaxlib
+            jaxlib_version = getattr(jaxlib, "__version__", None)
+        except ImportError:
+            pass
+        return cls(
+            run_id=run_id,
+            strategy=strategy,
+            model=model,
+            config=_config_dict(config),
+            mesh_shape=dict(mesh.shape) if mesh is not None else {},
+            mesh_axes=list(mesh.axis_names) if mesh is not None else [],
+            device_kind=getattr(dev, "device_kind", str(dev)),
+            device_count=jax.device_count(),
+            local_device_count=len(jax.local_devices()),
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            platform=dev.platform,
+            jax_version=jax.__version__,
+            jaxlib_version=jaxlib_version,
+            git_sha=_git_sha(),
+            started_utc=datetime.datetime.now(
+                datetime.timezone.utc).isoformat(timespec="seconds"),
+            collective_counts=collective_counts,
+            extra=dict(extra or {}),
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)

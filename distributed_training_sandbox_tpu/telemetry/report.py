@@ -1,0 +1,279 @@
+"""Cross-run report: discovery, side-by-side table, regression deltas.
+
+Library behind ``scripts/report.py``.  Reads the run directories the
+telemetry layer writes (``manifest.json`` + ``steps.jsonl`` +
+``summary.json``) and renders the strategy × payload-shape comparison
+table — step time, tokens/s, comm %, per-step collective counts — that
+BASELINE.md's NCCL-vs-ICI goal needs on the ICI side.
+
+Baselines for the regression check come in two shapes:
+  * another run dir / runs root / ``summary.json`` (same schema), or
+  * a bench-style JSON (``bench_matrix_tpu.json``'s ``{"matrix": [...]}``
+    rows, a bare row list, or a ``BENCH_*.json`` driver artifact whose
+    ``tail`` string embeds the row list) — field aliases are normalized
+    (``step_ms``/``step_time_ms``, ``tokens_per_sec``/``tokens_per_second``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+# identity fields a row may carry; two rows are comparable when every
+# field PRESENT IN BOTH matches and at least one name-ish field does
+_IDENTITY = ("strategy", "config", "model", "sequence_length",
+             "batch_size", "device_count")
+_ALIASES = {
+    "step_ms": "step_time_ms",
+    "tokens_per_sec": "tokens_per_second",
+    "seq_len": "sequence_length",
+    "seq": "sequence_length",
+    "batch": "batch_size",
+    "devices": "device_count",
+    "num_devices": "device_count",
+}
+
+
+# --------------------------------------------------------------- discovery
+
+def _is_run_dir(path: str) -> bool:
+    return any(os.path.isfile(os.path.join(path, f))
+               for f in ("manifest.json", "summary.json"))
+
+
+def discover_runs(paths: list[str]) -> list[dict]:
+    """Each path may be one run dir or a root of run dirs.  Returns one
+    record per run: ``{"dir", "manifest", "summary", "num_steps"}``,
+    sorted by run dir name (timestamps sort chronologically)."""
+    dirs: list[str] = []
+    for p in paths:
+        if _is_run_dir(p):
+            dirs.append(p)
+        elif os.path.isdir(p):
+            dirs += sorted(os.path.join(p, d) for d in os.listdir(p)
+                           if _is_run_dir(os.path.join(p, d)))
+    runs = []
+    for d in sorted(dict.fromkeys(dirs)):
+        rec: dict = {"dir": d, "manifest": None, "summary": None,
+                     "num_steps": 0}
+        for name, key in (("manifest.json", "manifest"),
+                          ("summary.json", "summary")):
+            f = os.path.join(d, name)
+            if os.path.isfile(f):
+                try:
+                    rec[key] = json.load(open(f))
+                except (OSError, json.JSONDecodeError):
+                    pass
+        steps = os.path.join(d, "steps.jsonl")
+        if os.path.isfile(steps):
+            with open(steps) as f:
+                rec["num_steps"] = sum(1 for line in f if line.strip())
+        runs.append(rec)
+    return runs
+
+
+def load_steps(run_dir: str) -> list[dict]:
+    out = []
+    path = os.path.join(run_dir, "steps.jsonl")
+    if os.path.isfile(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+    return out
+
+
+# ----------------------------------------------------------- normalization
+
+def _normalize(row: dict) -> dict:
+    out = {}
+    for k, v in row.items():
+        out[_ALIASES.get(k, k)] = v
+    return out
+
+
+def run_row(rec: dict) -> dict:
+    """Flatten one discovered run record into a normalized metrics row."""
+    man = rec.get("manifest") or {}
+    summ = dict(rec.get("summary") or {})
+    cfg = man.get("config") or {}
+    row: dict[str, Any] = {
+        "run_id": man.get("run_id") or summ.get("run_id")
+        or os.path.basename(rec["dir"]),
+        "dir": rec["dir"],
+        "strategy": summ.get("strategy") or man.get("strategy") or "?",
+        "model": summ.get("model") or man.get("model"),
+        "sequence_length": summ.get("sequence_length")
+        or cfg.get("sequence_length"),
+        "batch_size": summ.get("batch_size") or cfg.get("batch_size"),
+        "device_count": man.get("device_count"),
+        "platform": man.get("platform"),
+        "status": summ.get("status", "?"),
+        "num_steps": rec.get("num_steps", 0),
+        "collective_counts": man.get("collective_counts"),
+    }
+    for k in ("step_time_ms", "tokens_per_second", "tflops_per_device",
+              "avg_loss", "final_loss", "peak_memory_gb"):
+        if summ.get(k) is not None:
+            row[k] = summ[k]
+    sp = summ.get("comm_split") or {}
+    if sp.get("comm_fraction") is not None:
+        row["comm_fraction"] = sp["comm_fraction"]
+    return row
+
+
+def load_baseline_rows(path: str) -> list[dict]:
+    """Normalize any supported baseline source into metric rows."""
+    if os.path.isdir(path):
+        return [run_row(rec) for rec in discover_runs([path])]
+    try:
+        data = json.load(open(path))
+    except (OSError, json.JSONDecodeError):
+        return []
+    if isinstance(data, list):
+        rows = data
+    elif isinstance(data, dict):
+        if os.path.basename(path) == "summary.json":
+            return [run_row({"dir": os.path.dirname(path) or ".",
+                             "manifest": None, "summary": data,
+                             "num_steps": 0})]
+        rows = data.get("matrix") or data.get("rows")
+        if rows is None and isinstance(data.get("tail"), str):
+            rows = _rows_from_tail(data["tail"])
+        if rows is None:
+            rows = [data]
+    else:
+        return []
+    return [_normalize(r) for r in rows if isinstance(r, dict)]
+
+
+def _rows_from_tail(tail: str) -> list[dict]:
+    """Best-effort recovery of the row list a BENCH_*.json driver
+    artifact embeds in its truncated ``tail`` log text: parse every
+    balanced {...} object and keep the ones that look like metric rows."""
+    rows, depth, start = [], 0, None
+    for i, ch in enumerate(tail):
+        if ch == "{":
+            if depth == 0:
+                start = i
+            depth += 1
+        elif ch == "}" and depth:
+            depth -= 1
+            if depth == 0 and start is not None:
+                try:
+                    obj = json.loads(tail[start:i + 1])
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(obj, dict) and (
+                        "tokens_per_sec" in obj or "step_ms" in obj
+                        or "tokens_per_second" in obj
+                        or "step_time_ms" in obj):
+                    rows.append(obj)
+    return rows
+
+
+# ----------------------------------------------------------------- table
+
+def _fmt(v, spec=".1f") -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return format(v, spec)
+    return str(v)
+
+
+def render_table(rows: list[dict]) -> str:
+    """Strategy × payload-shape side-by-side markdown table."""
+    if not rows:
+        return "_no runs found_"
+    out = ["| run | strategy | model | seq | batch | dev | steps | "
+           "step ms | tok/s | TFLOPS/dev | comm % | collectives/step | "
+           "status |",
+           "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
+    for r in sorted(rows, key=lambda r: (r.get("strategy") or "",
+                                         str(r.get("model")),
+                                         r.get("run_id") or "")):
+        cc = r.get("collective_counts") or {}
+        cc_cell = str(cc.get("total")) if cc else "—"
+        comm = r.get("comm_fraction")
+        out.append(
+            f"| {r.get('run_id', '—')} | {r.get('strategy', '—')} "
+            f"| {r.get('model') or '—'} "
+            f"| {r.get('sequence_length') or '—'} "
+            f"| {r.get('batch_size') or '—'} "
+            f"| {r.get('device_count') or '—'} "
+            f"| {r.get('num_steps') or '—'} "
+            f"| {_fmt(r.get('step_time_ms'), '.2f')} "
+            f"| {_fmt(r.get('tokens_per_second'), '.0f')} "
+            f"| {_fmt(r.get('tflops_per_device'), '.2f')} "
+            f"| {_fmt(100 * comm if comm is not None else None, '.1f')} "
+            f"| {cc_cell} | {r.get('status', '—')} |")
+    return "\n".join(out)
+
+
+# ------------------------------------------------------------ regressions
+
+def _match(cur: dict, base: dict) -> bool:
+    name_match = False
+    for k in _IDENTITY:
+        a, b = cur.get(k), base.get(k)
+        if a is None or b is None:
+            continue
+        if a != b:
+            return False
+        if k in ("strategy", "config", "model"):
+            name_match = True
+    return name_match
+
+
+def check_regressions(current: list[dict], baseline: list[dict],
+                      tolerance: float = 0.15) -> list[dict]:
+    """Compare each current row against every comparable baseline row.
+    A regression is step time above baseline × (1+tol) or tokens/s below
+    baseline × (1−tol).  Returns one record per comparison; records with
+    ``"regressed": True`` should fail the caller."""
+    results = []
+    for cur in current:
+        for base in baseline:
+            if cur is base or not _match(cur, base):
+                continue
+            for metric, worse_is in (("step_time_ms", "higher"),
+                                     ("tokens_per_second", "lower")):
+                a, b = cur.get(metric), base.get(metric)
+                if a is None or b is None or not b:
+                    continue
+                delta = a / b - 1.0
+                regressed = (delta > tolerance if worse_is == "higher"
+                             else delta < -tolerance)
+                results.append({
+                    "run_id": cur.get("run_id"),
+                    "baseline": base.get("run_id") or base.get("config")
+                    or base.get("strategy"),
+                    "metric": metric,
+                    "current": a,
+                    "baseline_value": b,
+                    "delta": delta,
+                    "tolerance": tolerance,
+                    "regressed": regressed,
+                })
+    return results
+
+
+def render_regressions(results: list[dict]) -> str:
+    if not results:
+        return "_no comparable baseline rows_"
+    out = ["| run | baseline | metric | current | baseline | Δ | verdict |",
+           "|---|---|---|---|---|---|---|"]
+    for r in results:
+        out.append(
+            f"| {r['run_id']} | {r['baseline']} | {r['metric']} "
+            f"| {_fmt(r['current'], '.2f')} "
+            f"| {_fmt(r['baseline_value'], '.2f')} "
+            f"| {r['delta']:+.1%} "
+            f"| {'REGRESSED' if r['regressed'] else 'ok'} |")
+    return "\n".join(out)

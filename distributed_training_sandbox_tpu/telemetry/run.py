@@ -1,0 +1,213 @@
+"""TelemetryRun: the one object a training script holds.
+
+Glues the pieces together around the step loop:
+
+  * captures and writes the :class:`RunManifest` at entry;
+  * appends one ``schema.step_event`` per optimizer step (rank-0 only),
+    timing steps host-side and lifting rates from the
+    ``PerformanceTracker`` metrics dict the scripts already compute;
+  * owns the ``Profiler`` lifecycle — ``step()`` advances it and
+    ``__exit__`` stops it on *every* path, so an exception mid-loop
+    still flushes the in-flight ``jax.profiler`` trace (the reference
+    scripts only called ``prof.stop()`` on the happy path and lost the
+    trace on crash);
+  * writes ``summary.json`` at exit — aggregates plus, when profiling
+    was on, the ``trace_analysis.split_from_trace`` comm/compute split
+    and the trace dir; a crash writes status="crashed" with the error.
+
+Usage (the shape every scripts/ entrypoint now follows)::
+
+    with TelemetryRun("fsdp", config=cfg, mesh=mesh, model=args.model,
+                      collective_counts=counts, profiler=prof) as telem:
+        for i in range(cfg.num_steps):
+            ...
+            metrics = tracker.step(tokens, loss=loss)
+            telem.step(loss=loss, tokens=tokens, tracker_metrics=metrics)
+    # telemetry + profiler both finalized here, crash or not
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import time
+
+from ..utils.config import build_run_id, default_results_dir
+from .manifest import RunManifest
+from .schema import step_event
+from .writer import MetricsWriter
+
+
+class TelemetryRun:
+    def __init__(self, strategy: str, *, config=None, mesh=None,
+                 model: str | None = None,
+                 collective_counts: dict | None = None,
+                 extra: dict | None = None,
+                 results_dir: str | None = None,
+                 run_name: str | None = None,
+                 profiler=None, enabled: bool | None = None):
+        import jax
+        self.strategy = strategy
+        self.config = config
+        self.mesh = mesh
+        self.model = model
+        self.collective_counts = collective_counts
+        self.extra = extra
+        self.profiler = profiler
+        if results_dir is None:
+            results_dir = getattr(config, "results_dir", None) \
+                or default_results_dir()
+        if run_name is None:
+            run_name = getattr(config, "run_name", None)
+        want = getattr(config, "telemetry", True) if enabled is None \
+            else enabled
+        # telemetry artifacts are rank-0-only; profiler ownership is not
+        self.enabled = bool(want) and jax.process_index() == 0
+        self.results_dir = results_dir
+        self.run_id = self._unique_run_id(results_dir, strategy, run_name)
+        self.run_dir = os.path.join(results_dir, self.run_id) \
+            if self.enabled else None
+        self.writer: MetricsWriter | None = None
+        self.manifest: RunManifest | None = None
+        self._step_idx = 0
+        self._losses: list[float] = []
+        self._step_times: list[float] = []
+        self._last_tracker_metrics: dict | None = None
+        self._tokens_total = 0
+        self._t_prev: float | None = None
+        self._finalized = False
+
+    @staticmethod
+    def _unique_run_id(results_dir: str, strategy: str,
+                       run_name: str | None) -> str:
+        label = strategy if not run_name else f"{strategy}-{run_name}"
+        rid = build_run_id(label)
+        # second-resolution timestamps collide when two runs start in the
+        # same second (the test suite does exactly that)
+        n, base = 2, rid
+        while os.path.exists(os.path.join(results_dir, rid)):
+            rid = f"{base}-{n}"
+            n += 1
+        return rid
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self) -> "TelemetryRun":
+        if self.enabled:
+            self.manifest = RunManifest.capture(
+                self.strategy, run_id=self.run_id, config=self.config,
+                mesh=self.mesh, model=self.model,
+                collective_counts=self.collective_counts,
+                extra=self.extra)
+            self.writer = MetricsWriter(self.run_dir)
+            self.writer.write_manifest(self.manifest)
+        self._t_prev = time.perf_counter()
+        return self
+
+    def __enter__(self) -> "TelemetryRun":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        # profiler first: flush the in-flight trace whatever happened
+        if self.profiler is not None:
+            self.profiler.stop()
+        if exc_type is not None:
+            self.finalize(status="crashed",
+                          error=f"{exc_type.__name__}: {exc}")
+        else:
+            self.finalize()
+        return False
+
+    # ---- per-step -------------------------------------------------------
+    def step(self, *, loss=None, tokens: int | None = None,
+             tracker_metrics: dict | None = None, **extra) -> None:
+        """Record one optimizer step.  Also advances the owned profiler,
+        so the loop needs no separate ``prof.step()`` call."""
+        now = time.perf_counter()
+        dt = now - self._t_prev if self._t_prev is not None else None
+        self._t_prev = now
+        if self.profiler is not None:
+            self.profiler.step()
+        tm = tracker_metrics or {}
+        step_time = tm.get("last_step_time_s") or dt
+        if loss is not None:
+            self._losses.append(float(loss))
+        if step_time is not None:
+            self._step_times.append(float(step_time))
+        if tokens:
+            self._tokens_total += int(tokens)
+        if tm:
+            self._last_tracker_metrics = tm
+        idx = self._step_idx
+        self._step_idx += 1
+        if self.writer is not None:
+            self.writer.append_step(step_event(
+                idx, loss=loss, tokens=tokens, step_time_s=step_time,
+                tracker_metrics=tracker_metrics, **extra))
+
+    # ---- end-of-run -----------------------------------------------------
+    def _aggregates(self) -> dict:
+        out: dict = {
+            "steps_recorded": self._step_idx,
+            "total_tokens": self._tokens_total,
+        }
+        if self._losses:
+            out["first_loss"] = self._losses[0]
+            out["final_loss"] = self._losses[-1]
+            out["avg_loss"] = sum(self._losses) / len(self._losses)
+        if self._step_times:
+            # median over the post-compile tail: step 0 carries the jit
+            times = self._step_times[1:] or self._step_times
+            out["step_time_ms"] = statistics.median(times) * 1e3
+            out["step_time_ms_mean"] = sum(times) / len(times) * 1e3
+        tm = self._last_tracker_metrics or {}
+        for k in ("tokens_per_second", "steps_per_second",
+                  "tflops_per_device", "peak_memory_gb"):
+            if tm.get(k) is not None:
+                out[k] = tm[k]
+        return out
+
+    def finalize(self, status: str = "completed", error: str | None = None,
+                 **extra) -> dict | None:
+        """Write ``summary.json``.  Idempotent: a crash path overwrites a
+        not-yet-written summary only; explicit double calls are no-ops."""
+        if self._finalized:
+            return None
+        self._finalized = True
+        if not self.enabled or self.writer is None:
+            return None
+        summary: dict = {
+            "run_id": self.run_id,
+            "strategy": self.strategy,
+            "model": self.model,
+            "status": status,
+        }
+        if error:
+            summary["error"] = error
+        cfg = self.manifest.config if self.manifest else {}
+        for k in ("sequence_length", "batch_size", "num_steps",
+                  "precision", "seed"):
+            if k in cfg:
+                summary[k] = cfg[k]
+        summary.update(self._aggregates())
+        summary.update(extra)
+        # post-run profiling hook: comm/compute split from the trace the
+        # owned Profiler just flushed
+        prof = self.profiler
+        if prof is not None and getattr(prof, "enabled", False):
+            summary["trace_dir"] = prof.trace_dir
+            try:
+                from ..utils.trace_analysis import split_from_trace
+                sp = split_from_trace(prof.trace_dir)
+            except Exception:   # trace parsing must never fail the run
+                sp = None
+            if sp is not None:
+                summary["comm_split"] = {
+                    "comm_us": sp.comm_us,
+                    "compute_us": sp.compute_us,
+                    "other_us": sp.other_us,
+                    "comm_fraction": sp.comm_fraction,
+                    "trace_file": sp.trace_file,
+                }
+        self.writer.write_summary(summary)
+        self.writer.close()
+        return summary

@@ -1,0 +1,77 @@
+"""The shared per-step event schema.
+
+One JSONL line per optimizer step, identical across every strategy
+script, so ``scripts/report.py`` can compare runs without per-script
+parsers (the ``*_results/`` dirs each grew a bespoke schema; this is
+the one they converge on going forward).
+
+Field semantics:
+  ``schema``            int, :data:`STEP_SCHEMA_VERSION`
+  ``step``              0-based optimizer-step index within the run
+  ``loss``              scalar loss for this step (None while unknown)
+  ``tokens``            tokens consumed by this step (global batch)
+  ``step_time_s``       wall-clock of this step, host-side
+  ``tokens_per_second`` cumulative post-warmup rate (tracker window)
+  ``tflops_per_device`` analytic-FLOPs rate per device (None w/o model)
+  ``peak_memory_gb``    allocator peak on device 0 (None on CPU sim)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+STEP_SCHEMA_VERSION = 1
+
+# ordered for stable JSONL key order; value = required at write time
+STEP_FIELDS = {
+    "schema": True,
+    "step": True,
+    "loss": False,
+    "tokens": False,
+    "step_time_s": False,
+    "tokens_per_second": False,
+    "tflops_per_device": False,
+    "peak_memory_gb": False,
+}
+
+
+def step_event(step: int, *, loss: float | None = None,
+               tokens: int | None = None,
+               step_time_s: float | None = None,
+               tracker_metrics: dict | None = None,
+               **extra: Any) -> dict:
+    """Build one schema-versioned step event.  ``tracker_metrics`` is the
+    dict returned by ``PerformanceTracker.step``/``.metrics`` — the rate
+    and memory fields are lifted from it when present."""
+    tm = tracker_metrics or {}
+    ev: dict[str, Any] = {
+        "schema": STEP_SCHEMA_VERSION,
+        "step": int(step),
+        "loss": None if loss is None else float(loss),
+        "tokens": None if tokens is None else int(tokens),
+        "step_time_s": (float(step_time_s) if step_time_s is not None
+                        else tm.get("last_step_time_s")),
+        "tokens_per_second": tm.get("tokens_per_second"),
+        "tflops_per_device": tm.get("tflops_per_device"),
+        "peak_memory_gb": tm.get("peak_memory_gb"),
+    }
+    for k, v in extra.items():
+        ev.setdefault(k, v)
+    return ev
+
+
+def validate_step(ev: dict) -> list[str]:
+    """Schema-check one parsed event; returns a list of problems (empty
+    when valid).  Used by tests and by ``report.py --strict``."""
+    problems = []
+    for field, required in STEP_FIELDS.items():
+        if required and field not in ev:
+            problems.append(f"missing required field {field!r}")
+    if ev.get("schema") not in (None, STEP_SCHEMA_VERSION):
+        problems.append(f"unknown schema version {ev.get('schema')!r}")
+    for field in ("loss", "step_time_s", "tokens_per_second",
+                  "tflops_per_device", "peak_memory_gb"):
+        v = ev.get(field)
+        if v is not None and not isinstance(v, (int, float)):
+            problems.append(f"{field} must be numeric or null, got {v!r}")
+    return problems

@@ -1,0 +1,67 @@
+"""Filesystem half of the telemetry layer: one run directory, three
+artifacts.
+
+Layout contract (read back by ``telemetry.report`` / ``scripts/report.py``):
+
+    <results_dir>/<run_id>/
+        manifest.json   written once at startup (RunManifest)
+        steps.jsonl     appended once per optimizer step (schema.step_event)
+        summary.json    written at finalize (and overwritten on crash
+                        with status="crashed" so partial runs are visible)
+
+The writer is deliberately dumb — no rank logic, no aggregation; the
+rank-0-only policy and the summary contents live in ``TelemetryRun``.
+Steps are flushed per line so a crash loses at most the in-flight event.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+class MetricsWriter:
+    MANIFEST = "manifest.json"
+    STEPS = "steps.jsonl"
+    SUMMARY = "summary.json"
+
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        os.makedirs(run_dir, exist_ok=True)
+        self._steps_f = None
+        self.steps_written = 0
+
+    # ---- artifacts ------------------------------------------------------
+    def write_manifest(self, manifest) -> str:
+        path = os.path.join(self.run_dir, self.MANIFEST)
+        d = manifest.to_dict() if hasattr(manifest, "to_dict") else manifest
+        with open(path, "w") as f:
+            json.dump(d, f, indent=2, default=str)
+            f.write("\n")
+        return path
+
+    def append_step(self, event: dict) -> None:
+        if self._steps_f is None:
+            self._steps_f = open(os.path.join(self.run_dir, self.STEPS),
+                                 "a", buffering=1)
+        self._steps_f.write(json.dumps(event, default=str) + "\n")
+        self.steps_written += 1
+
+    def write_summary(self, summary: dict) -> str:
+        path = os.path.join(self.run_dir, self.SUMMARY)
+        with open(path, "w") as f:
+            json.dump(summary, f, indent=2, default=str)
+            f.write("\n")
+        return path
+
+    def close(self) -> None:
+        if self._steps_f is not None:
+            self._steps_f.close()
+            self._steps_f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

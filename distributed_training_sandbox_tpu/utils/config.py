@@ -15,6 +15,13 @@ from dataclasses import dataclass, field, fields
 from .profiling import default_trace_dir
 
 
+def default_results_dir() -> str:
+    """RESULTS_DIR env contract for telemetry run directories, sibling of
+    the TRACE_DIR one (``profiling.default_trace_dir``)."""
+    import os
+    return os.environ.get("RESULTS_DIR", "./runs")
+
+
 def build_run_id(label: str | None = None) -> str:
     """``YYYYMMDD-HHMMSS[-label]`` run ids, UTC, sanitized label — twin of
     ``modal_utils.build_run_id`` (``modal_utils.py:98-104``)."""
@@ -36,6 +43,8 @@ class TrainConfig:
     run_name: str | None = None
     trace_dir: str = field(default_factory=default_trace_dir)
     profile: bool = True
+    results_dir: str = field(default_factory=default_results_dir)
+    telemetry: bool = True
 
     @classmethod
     def from_args(cls, argv=None, **overrides) -> "TrainConfig":
@@ -82,4 +91,12 @@ def build_argparser(parser: argparse.ArgumentParser | None = None):
     p.add_argument("--trace-dir", dest="trace_dir", type=str, default=None)
     p.add_argument("--no-profile", dest="profile", action="store_false",
                    default=None)
+    p.add_argument("--results-dir", dest="results_dir", type=str,
+                   default=None,
+                   help="telemetry run-dir root (default $RESULTS_DIR "
+                        "or ./runs)")
+    p.add_argument("--no-telemetry", dest="telemetry",
+                   action="store_false", default=None,
+                   help="disable the manifest/steps.jsonl/summary.json "
+                        "run artifacts")
     return p

@@ -25,11 +25,16 @@ class PerformanceTracker:
         self.loss_count = 0
         self.start = time.perf_counter()
         self._warmed_up = warmup_steps == 0
+        self._prev_step_t = self.start
+        self.last_step_time_s: float | None = None
 
     def step(self, tokens: int, loss: float | None = None) -> dict | None:
         """Record one optimizer step of ``tokens`` tokens.  Returns the metric
         dict once past warmup, else None.  Restart-at-warmup matches reference
         ``fsdp/utils.py:155-159``."""
+        now = time.perf_counter()
+        self.last_step_time_s = now - self._prev_step_t
+        self._prev_step_t = now
         self.step_count += 1
         if not self._warmed_up:
             if self.step_count >= self.warmup_steps:
@@ -56,6 +61,10 @@ class PerformanceTracker:
             "total_tokens": self.tokens,
             "elapsed_s": elapsed,
         }
+        if self.last_step_time_s is not None:
+            # host wall-time of the most recent step — the per-step field
+            # the telemetry JSONL schema records
+            out["last_step_time_s"] = self.last_step_time_s
         if self.loss_count:
             out["avg_loss"] = self.total_loss / self.loss_count
         if self.flops_per_token:

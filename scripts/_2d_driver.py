@@ -49,6 +49,7 @@ def run(mode: str, argv=None):
         annotate, make_mesh, print_memory_stats, set_seed)
     from distributed_training_sandbox_tpu.utils.flops import (
         get_model_flops_per_token)
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
 
     cfg = TrainConfig.from_args(
         rest, sequence_length=256 if args.model == "tiny" else 8192)
@@ -112,20 +113,23 @@ def run(mode: str, argv=None):
     metrics = None
     batches = packed_batches(input_ids, labels, cfg.batch_size,
                              epochs=cfg.num_epochs * cfg.num_steps)
-    for i in range(cfg.num_steps):
-        with annotate("data_movement"):
-            bi, bl = next(batches)
-            batch = (jnp.asarray(bi), jnp.asarray(bl))
-        shards, opt_state, loss = step(shards, opt_state, batch)
-        jax.block_until_ready(loss)
-        metrics = tracker.step(cfg.batch_size * cfg.sequence_length,
-                               loss=float(loss))
-        if prof:
-            prof.step()
-        if i % 5 == 0 or i == cfg.num_steps - 1:
-            print(f"[{name}] step {i:3d} loss {float(loss):.4f}")
+    with TelemetryRun(name, config=cfg, mesh=mesh, model=args.model,
+                      collective_counts=counts, profiler=prof,
+                      extra={mode: second}) as telem:
+        for i in range(cfg.num_steps):
+            with annotate("data_movement"):
+                bi, bl = next(batches)
+                batch = (jnp.asarray(bi), jnp.asarray(bl))
+            shards, opt_state, loss = step(shards, opt_state, batch)
+            jax.block_until_ready(loss)
+            metrics = tracker.step(cfg.batch_size * cfg.sequence_length,
+                                   loss=float(loss))
+            telem.step(loss=float(loss),
+                       tokens=cfg.batch_size * cfg.sequence_length,
+                       tracker_metrics=metrics)
+            if i % 5 == 0 or i == cfg.num_steps - 1:
+                print(f"[{name}] step {i:3d} loss {float(loss):.4f}")
     if prof:
-        prof.stop()
         from distributed_training_sandbox_tpu.utils.trace_analysis import (
             split_from_trace)
         sp_ = split_from_trace(cfg.trace_dir)
@@ -136,4 +140,6 @@ def run(mode: str, argv=None):
         print(f"[{name}] tokens/s {metrics['tokens_per_second']:.1f} "
               f"TFLOPS/dev {metrics.get('tflops_per_device', 0):.2f} "
               f"avg_loss {metrics.get('avg_loss', float('nan')):.4f}")
+    if telem.run_dir:
+        print(f"[{name}] telemetry in {telem.run_dir}")
     return metrics

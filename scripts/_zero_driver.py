@@ -21,9 +21,12 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def _time_steps(step_fn, state, batch, n_steps, profiler=None, label=""):
+def _time_steps(step_fn, state, batch, n_steps, telem=None, label="",
+                tokens_per_step=None):
     """Run n_steps (first is untimed warmup/compile, like the reference's
-    explicit warmup step, zero1.py:118-125). Returns (state, losses, sec/step)."""
+    explicit warmup step, zero1.py:118-125). Returns (state, losses, sec/step).
+    ``telem`` is the leg's TelemetryRun — it records each step AND advances
+    the profiler it owns."""
     import jax
     from distributed_training_sandbox_tpu.utils import local_scalar
     params, opt = state
@@ -36,8 +39,9 @@ def _time_steps(step_fn, state, batch, n_steps, profiler=None, label=""):
             t0 = time.perf_counter()  # discard compile step
         else:
             losses.append(local_scalar(loss))
-        if profiler:
-            profiler.step()
+        if telem is not None:
+            telem.step(loss=losses[-1] if losses else None,
+                       tokens=tokens_per_step)
     dt = (time.perf_counter() - t0) / max(n_steps - 1, 1)
     print(f"[{label}] {len(losses)} timed steps, {dt * 1e3:.2f} ms/step, "
           f"final loss {losses[-1]:.6f}")
@@ -101,17 +105,23 @@ def run_zero_ab(stage: int, argv=None):
         return Profiler(trace_dir=f"{cfg.trace_dir}/{name}/{leg}",
                         schedule=ProfileSchedule())
 
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
+
     # ---- leg A: baseline Adam (replicated state, DDP-style) --------------
     base_opt = optim.adam_init(params)
     base_step = make_ddp_train_step(
         mse_loss, lambda g, s, p: optim.adam_update(g, s, p), mesh, "dp",
         donate=False)
     base_counts = count_collectives(base_step, params, base_opt, batch)
-    prof = make_prof("baseline")
-    (_, base_opt_f), base_losses, base_dt = _time_steps(
-        base_step, (params, base_opt), batch, cfg.num_steps, prof, "baseline")
-    if prof:
-        prof.stop()
+    # one TelemetryRun per leg: the crash-safe owner of that leg's profiler
+    with TelemetryRun(f"{name}-baseline", config=cfg, mesh=mesh,
+                      model="toy-mlp", collective_counts=base_counts,
+                      profiler=make_prof("baseline"),
+                      extra={"leg": "baseline", "stage": stage,
+                             "scale": args.scale}) as telem_a:
+        (_, base_opt_f), base_losses, base_dt = _time_steps(
+            base_step, (params, base_opt), batch, cfg.num_steps, telem_a,
+            "baseline", tokens_per_step=cfg.batch_size)
     base_opt_mb = tree_local_size_mb(base_opt_f.mu) + \
         tree_local_size_mb(base_opt_f.nu)
 
@@ -127,11 +137,15 @@ def run_zero_ab(stage: int, argv=None):
         step = make_zero3_train_step(loss_fn, mesh, "dp", donate=False)
         state0 = (shard_params_zero3(params, mesh, "dp"), opt)
     shard_counts = count_collectives(step, *state0, batch)
-    prof = make_prof("sharded")
-    (shard_params_f, opt_f), shard_losses, shard_dt = _time_steps(
-        step, state0, batch, cfg.num_steps, prof, name)
-    if prof:
-        prof.stop()
+    with TelemetryRun(name, config=cfg, mesh=mesh, model="toy-mlp",
+                      collective_counts=shard_counts,
+                      profiler=make_prof("sharded"),
+                      extra={"leg": "sharded", "stage": stage,
+                             "scale": args.scale,
+                             "rebuild": args.rebuild}) as telem_b:
+        (shard_params_f, opt_f), shard_losses, shard_dt = _time_steps(
+            step, state0, batch, cfg.num_steps, telem_b, name,
+            tokens_per_step=cfg.batch_size)
     shard_opt_mb = tree_local_size_mb(opt_f.mu) + tree_local_size_mb(opt_f.nu)
 
     # ---- comparison report (the reference's pass signal) -----------------
@@ -162,7 +176,11 @@ def run_zero_ab(stage: int, argv=None):
     print(f"[{name}] loss drift baseline-vs-sharded: {drift:.2e} "
           f"({'OK' if drift < 1e-3 else 'DIVERGED'})")
     print_memory_stats(f"{name}-final")
+    if telem_b.run_dir:
+        print(f"[{name}] telemetry in {telem_a.run_dir} and {telem_b.run_dir}")
     return {
+        "telemetry_dirs": [d for d in (telem_a.run_dir, telem_b.run_dir)
+                           if d],
         "stage": stage, "ws": ws,
         "base_opt_mb": base_opt_mb, "shard_opt_mb": shard_opt_mb,
         "base_ms": base_dt * 1e3, "shard_ms": shard_dt * 1e3,

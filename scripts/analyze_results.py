@@ -609,6 +609,16 @@ def main(argv=None):
         "`python scripts/analyze_results.py` — the twin of the reference's "
         "`fp8/visualize_code.ipynb` analysis pass.",
         "",
+        "> Going forward, training runs emit structured telemetry",
+        "> (`<results_dir>/<run_id>/{manifest.json,steps.jsonl,"
+        "summary.json}`)",
+        "> and future result files are generated from those run dirs via",
+        "> `python scripts/report.py` (side-by-side strategy table + "
+        "regression",
+        "> deltas) — see \"Telemetry & run reports\" in `README.md`.  "
+        "The bespoke",
+        "> per-script JSON artifacts below predate that layer.",
+        "",
         "## Flagship training runs (`scripts/train_flagship.py`)",
         "",
         flagship_section(),

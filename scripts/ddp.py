@@ -60,6 +60,7 @@ def main(argv=None):
     from distributed_training_sandbox_tpu.utils import (
         TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
         PerformanceTracker, print_memory_stats, annotate)
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
     from distributed_training_sandbox_tpu.models import zero_toy_mlp
     from distributed_training_sandbox_tpu.models.mlp import mse_loss
     from distributed_training_sandbox_tpu.parallel import (
@@ -110,24 +111,28 @@ def main(argv=None):
                     schedule=ProfileSchedule(skip_first=5, wait=1, warmup=2,
                                              active=5)) if cfg.profile else None
     metrics = None
-    for i in range(cfg.num_steps):
-        with annotate("data_movement"):
-            key, bk = jax.random.split(key)
-            batch = make_batch(bk)
-        params, opt_state, loss = step(params, opt_state, batch)
-        jax.block_until_ready(loss)  # step isolation (dist.barrier twin)
-        metrics = tracker.step(cfg.batch_size, loss=float(loss))
-        if prof:
-            prof.step()
-        if i % 5 == 0 or i == cfg.num_steps - 1:
-            print(f"[ddp] step {i:3d} loss {float(loss):.6f}")
-    if prof:
-        prof.stop()
+    # TelemetryRun owns the profiler: a crash mid-loop still flushes the
+    # in-flight trace and writes a status="crashed" summary
+    with TelemetryRun("ddp", config=cfg, mesh=mesh, model="mlp",
+                      collective_counts=counts, profiler=prof) as telem:
+        for i in range(cfg.num_steps):
+            with annotate("data_movement"):
+                key, bk = jax.random.split(key)
+                batch = make_batch(bk)
+            params, opt_state, loss = step(params, opt_state, batch)
+            jax.block_until_ready(loss)  # step isolation (dist.barrier twin)
+            metrics = tracker.step(cfg.batch_size, loss=float(loss))
+            telem.step(loss=float(loss), tokens=cfg.batch_size,
+                       tracker_metrics=metrics)
+            if i % 5 == 0 or i == cfg.num_steps - 1:
+                print(f"[ddp] step {i:3d} loss {float(loss):.6f}")
 
     print_memory_stats("ddp-final", params=params, opt_state=opt_state)
     if metrics:
         print(f"[ddp] steps/s {metrics['steps_per_second']:.2f} "
               f"avg_loss {metrics.get('avg_loss', float('nan')):.6f}")
+    if telem.run_dir:
+        print(f"[ddp] telemetry in {telem.run_dir}")
     print(f"[ddp] traces in {cfg.trace_dir}" if cfg.profile else "[ddp] done")
     return metrics
 
@@ -141,6 +146,7 @@ def classification_main(args, rest):
     from distributed_training_sandbox_tpu.utils import (
         TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
         PerformanceTracker, print_memory_stats, annotate)
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
     from distributed_training_sandbox_tpu.models import (
         transformer as T, init_classifier_params, classification_loss,
         classification_accuracy, MODEL_REGISTRY)
@@ -206,24 +212,25 @@ def classification_main(args, rest):
                                              active=5)) if cfg.profile else None
     metrics = None
     batch = first
-    for i in range(cfg.num_steps):
-        with annotate("data_movement"):
-            jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
-        params, opt_state, loss = step(params, opt_state, jbatch)
-        jax.block_until_ready(loss)
-        metrics = tracker.step(int(jbatch["input_ids"].size),
-                               loss=float(loss))
-        if prof:
-            prof.step()
-        if i % 5 == 0 or i == cfg.num_steps - 1:
-            print(f"[ddp] step {i:3d} loss {float(loss):.4f} "
-                  f"(padded width {jbatch['input_ids'].shape[1]})")
-        try:
-            batch = next(batches)
-        except StopIteration:
-            break
-    if prof:
-        prof.stop()
+    with TelemetryRun("ddp", config=cfg, mesh=mesh, model=args.model,
+                      collective_counts=counts, profiler=prof) as telem:
+        for i in range(cfg.num_steps):
+            with annotate("data_movement"):
+                jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+            params, opt_state, loss = step(params, opt_state, jbatch)
+            jax.block_until_ready(loss)
+            metrics = tracker.step(int(jbatch["input_ids"].size),
+                                   loss=float(loss))
+            telem.step(loss=float(loss),
+                       tokens=int(jbatch["input_ids"].size),
+                       tracker_metrics=metrics)
+            if i % 5 == 0 or i == cfg.num_steps - 1:
+                print(f"[ddp] step {i:3d} loss {float(loss):.4f} "
+                      f"(padded width {jbatch['input_ids'].shape[1]})")
+            try:
+                batch = next(batches)
+            except StopIteration:
+                break
 
     acc_fn = jax.jit(lambda p, b: classification_accuracy(p, b, mcfg))
     acc = float(acc_fn(params, {k: jnp.asarray(v)
@@ -234,6 +241,8 @@ def classification_main(args, rest):
               f"tok/s {metrics['tokens_per_second']:.0f} "
               f"avg_loss {metrics.get('avg_loss', float('nan')):.4f} "
               f"train-batch acc {acc:.3f}")
+    if telem.run_dir:
+        print(f"[ddp] telemetry in {telem.run_dir}")
     return metrics
 
 

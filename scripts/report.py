@@ -1,0 +1,118 @@
+"""Cross-run telemetry report CLI — the ICI half of BASELINE.md's
+NCCL-vs-ICI side-by-side.
+
+Discovers the run directories the telemetry layer writes
+(``<results_dir>/<run_id>/{manifest.json,steps.jsonl,summary.json}``),
+renders the strategy × payload-shape comparison table (step time,
+tokens/s, TFLOPS/device, comm %, per-step collective counts), and —
+with ``--baseline`` — computes regression deltas against a prior run
+dir, a runs root, a ``summary.json``, or a bench-style JSON
+(``bench_matrix_tpu.json`` / ``BENCH_*.json``), exiting nonzero when
+any comparable metric regresses beyond ``--tolerance``.
+
+Usage:
+  python scripts/report.py [runs_root ...]           # default ./runs
+  python scripts/report.py runs --baseline old_runs --tolerance 0.15
+  python scripts/report.py runs --baseline bench_matrix_tpu.json
+  python scripts/report.py runs --steps               # per-step tail
+  python scripts/report.py runs --json                # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+# Prepend the checkout root so the source tree always wins over any
+# installed copy of the package (`pip install -e .` makes this a no-op).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from distributed_training_sandbox_tpu.telemetry import report as R  # noqa: E402
+from distributed_training_sandbox_tpu.telemetry.schema import (  # noqa: E402
+    validate_step)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="side-by-side table + regression check over "
+                    "telemetry run dirs")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="run dirs or roots of run dirs (default: ./runs "
+                        "or $RESULTS_DIR)")
+    p.add_argument("--baseline", default=None,
+                   help="prior run dir / runs root / summary.json / "
+                        "bench-style JSON to diff against")
+    p.add_argument("--tolerance", type=float, default=0.15,
+                   help="allowed fractional slowdown before a metric "
+                        "counts as regressed (default 0.15)")
+    p.add_argument("--steps", action="store_true",
+                   help="also print the last 5 step events per run")
+    p.add_argument("--strict", action="store_true",
+                   help="schema-validate every step event; exit nonzero "
+                        "on violations")
+    p.add_argument("--json", dest="as_json", action="store_true",
+                   help="emit the normalized rows + regression records "
+                        "as JSON instead of tables")
+    args = p.parse_args(argv)
+
+    if not args.paths:
+        from distributed_training_sandbox_tpu.utils.config import (
+            default_results_dir)
+        args.paths = [default_results_dir()]
+
+    recs = R.discover_runs(args.paths)
+    rows = [R.run_row(rec) for rec in recs]
+
+    schema_problems = []
+    if args.strict:
+        for rec in recs:
+            for ev in R.load_steps(rec["dir"]):
+                for prob in validate_step(ev):
+                    schema_problems.append(
+                        f"{rec['dir']} step {ev.get('step')}: {prob}")
+
+    comparisons = []
+    if args.baseline:
+        base_rows = R.load_baseline_rows(args.baseline)
+        comparisons = R.check_regressions(rows, base_rows,
+                                          tolerance=args.tolerance)
+    regressed = [c for c in comparisons if c["regressed"]]
+
+    if args.as_json:
+        print(json.dumps({"runs": rows, "comparisons": comparisons,
+                          "schema_problems": schema_problems}, indent=2,
+                         default=str))
+    else:
+        print(f"# Telemetry report — {len(rows)} run(s) from "
+              f"{', '.join(args.paths)}\n")
+        print(R.render_table(rows))
+        if args.steps:
+            for rec in recs:
+                tail = R.load_steps(rec["dir"])[-5:]
+                if tail:
+                    print(f"\n## last steps — {rec['dir']}")
+                    for ev in tail:
+                        print(json.dumps(ev, default=str))
+        if args.baseline:
+            print(f"\n## Regression check vs {args.baseline} "
+                  f"(tolerance ±{args.tolerance:.0%})\n")
+            print(R.render_regressions(comparisons))
+            if regressed:
+                print(f"\nREGRESSIONS: {len(regressed)} metric(s) beyond "
+                      f"tolerance")
+            elif comparisons:
+                print("\nno regressions beyond tolerance")
+        if schema_problems:
+            print("\n## Schema violations\n")
+            for prob in schema_problems:
+                print(f"* {prob}")
+
+    if regressed or schema_problems:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

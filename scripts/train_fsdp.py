@@ -52,8 +52,10 @@ def main(argv=None):
         PerformanceTracker, print_memory_stats, annotate)
     from distributed_training_sandbox_tpu.utils.flops import (
         get_model_flops_per_token)
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
     from distributed_training_sandbox_tpu.models import transformer as T
     from distributed_training_sandbox_tpu.parallel import fsdp
+    from distributed_training_sandbox_tpu.ops import count_collectives
     from distributed_training_sandbox_tpu.data import (
         make_packed_dataset, packed_batches)
 
@@ -114,23 +116,30 @@ def main(argv=None):
                     schedule=ProfileSchedule(skip_first=0, wait=5, warmup=5,
                                              active=10)) if cfg.profile else None
 
+    probe = (jnp.zeros((cfg.batch_size, cfg.sequence_length), jnp.int32),) * 2
+    counts = count_collectives(step, shards, opt_state, probe)
+    print(f"[fsdp] per-step collectives (HLO): {counts}")
+
     metrics = None
     tokens_per_step = cfg.batch_size * cfg.sequence_length
     batches = packed_batches(input_ids, labels, cfg.batch_size,
                              epochs=cfg.num_epochs * cfg.num_steps)
-    for i in range(cfg.num_steps):
-        with annotate("data_movement"):
-            bi, bl = next(batches)
-            batch = (jnp.asarray(bi), jnp.asarray(bl))
-        shards, opt_state, loss = step(shards, opt_state, batch)
-        jax.block_until_ready(loss)
-        metrics = tracker.step(tokens_per_step, loss=float(loss))
-        if prof:
-            prof.step()
-        if i % 5 == 0 or i == cfg.num_steps - 1:
-            print(f"[fsdp] step {i:3d} loss {float(loss):.4f}")
+    with TelemetryRun("fsdp", config=cfg, mesh=mesh, model=args.model,
+                      collective_counts=counts, profiler=prof,
+                      extra={"variant": args.variant,
+                             "reshard_after_forward": args.reshard}) as telem:
+        for i in range(cfg.num_steps):
+            with annotate("data_movement"):
+                bi, bl = next(batches)
+                batch = (jnp.asarray(bi), jnp.asarray(bl))
+            shards, opt_state, loss = step(shards, opt_state, batch)
+            jax.block_until_ready(loss)
+            metrics = tracker.step(tokens_per_step, loss=float(loss))
+            telem.step(loss=float(loss), tokens=tokens_per_step,
+                       tracker_metrics=metrics)
+            if i % 5 == 0 or i == cfg.num_steps - 1:
+                print(f"[fsdp] step {i:3d} loss {float(loss):.4f}")
     if prof:
-        prof.stop()
         from distributed_training_sandbox_tpu.utils.trace_analysis import (
             split_from_trace)
         sp = split_from_trace(cfg.trace_dir)
@@ -143,6 +152,8 @@ def main(argv=None):
               f"steps/s {metrics['steps_per_second']:.3f} "
               f"TFLOPS/dev {metrics.get('tflops_per_device', 0):.2f} "
               f"avg_loss {metrics.get('avg_loss', float('nan')):.4f}")
+    if telem.run_dir:
+        print(f"[fsdp] telemetry in {telem.run_dir}")
     return metrics
 
 

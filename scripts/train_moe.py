@@ -55,6 +55,7 @@ def main(argv=None):
         annotate, make_mesh, print_memory_stats, set_seed)
     from distributed_training_sandbox_tpu.utils.flops import (
         get_model_flops_per_token)
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
 
     cfg = TrainConfig.from_args(
         rest, sequence_length=256 if args.model == "tiny" else 8192)
@@ -121,20 +122,24 @@ def main(argv=None):
     metrics = None
     batches = packed_batches(input_ids, labels, cfg.batch_size,
                              epochs=cfg.num_epochs * cfg.num_steps)
-    for i in range(cfg.num_steps):
-        with annotate("data_movement"):
-            bi, bl = next(batches)
-            batch = (jnp.asarray(bi), jnp.asarray(bl))
-        shards, opt_state, loss = step(shards, opt_state, batch)
-        jax.block_until_ready(loss)
-        metrics = tracker.step(cfg.batch_size * cfg.sequence_length,
-                               loss=float(loss))
-        if prof:
-            prof.step()
-        if i % 5 == 0 or i == cfg.num_steps - 1:
-            print(f"[train_moe] step {i:3d} loss {float(loss):.4f}")
+    with TelemetryRun("moe", config=cfg, mesh=mesh, model=args.model,
+                      collective_counts=counts, profiler=prof,
+                      extra={"experts": args.experts, "ep": args.ep,
+                             "top_k": args.top_k}) as telem:
+        for i in range(cfg.num_steps):
+            with annotate("data_movement"):
+                bi, bl = next(batches)
+                batch = (jnp.asarray(bi), jnp.asarray(bl))
+            shards, opt_state, loss = step(shards, opt_state, batch)
+            jax.block_until_ready(loss)
+            metrics = tracker.step(cfg.batch_size * cfg.sequence_length,
+                                   loss=float(loss))
+            telem.step(loss=float(loss),
+                       tokens=cfg.batch_size * cfg.sequence_length,
+                       tracker_metrics=metrics)
+            if i % 5 == 0 or i == cfg.num_steps - 1:
+                print(f"[train_moe] step {i:3d} loss {float(loss):.4f}")
     if prof:
-        prof.stop()
         from distributed_training_sandbox_tpu.utils.trace_analysis import (
             split_from_trace)
         sp_ = split_from_trace(cfg.trace_dir)
@@ -145,6 +150,8 @@ def main(argv=None):
               f"TFLOPS/dev (active) "
               f"{metrics.get('tflops_per_device', 0):.2f} "
               f"avg_loss {metrics.get('avg_loss', float('nan')):.4f}")
+    if telem.run_dir:
+        print(f"[train_moe] telemetry in {telem.run_dir}")
     return metrics
 
 

@@ -2,9 +2,17 @@
 reference's gloo-on-2-CPU-ranks mode.  Must configure XLA before the backend
 initializes, hence the env mutation at import time."""
 
+import os
+import tempfile
+
 from distributed_training_sandbox_tpu.utils import use_cpu_devices
 
 use_cpu_devices(8)
+
+# Telemetry runs from in-process script invocations go to a throwaway dir,
+# not ./runs in the checkout (subprocess-spawning tests inherit this too).
+os.environ.setdefault(
+    "RESULTS_DIR", tempfile.mkdtemp(prefix="dts-telemetry-runs-"))
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402

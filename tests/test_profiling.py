@@ -1,0 +1,138 @@
+"""Boundary semantics of the torch.profiler-style schedule state machine
+(``utils.profiling``): skip_first=0, repeat>1 cycle wraparound, repeat
+exhaustion, and the Profiler starting/stopping jax traces at the exact
+phase transitions (jax.profiler stubbed)."""
+
+import pytest
+
+from distributed_training_sandbox_tpu.utils.profiling import (
+    ProfileSchedule, Profiler)
+
+
+# ------------------------------------------------------ ProfileSchedule
+
+def _phases(sched, n):
+    return [sched.phase(i) for i in range(n)]
+
+
+def test_schedule_skip_first_zero_starts_in_wait():
+    s = ProfileSchedule(skip_first=0, wait=1, warmup=1, active=2, repeat=1)
+    # cycle = wait(1) + warmup(1) + active(2) = 4, one repeat then done
+    assert _phases(s, 6) == ["wait", "trace", "trace", "trace",
+                             "done", "done"]
+
+
+def test_schedule_repeat_cycles_wrap_around():
+    s = ProfileSchedule(skip_first=0, wait=1, warmup=1, active=1, repeat=2)
+    # two 3-step cycles: wait/trace/trace, wait/trace/trace, then done
+    assert _phases(s, 8) == ["wait", "trace", "trace",
+                             "wait", "trace", "trace",
+                             "done", "done"]
+
+
+def test_schedule_repeat_exhaustion_is_terminal():
+    s = ProfileSchedule(skip_first=2, wait=1, warmup=0, active=1, repeat=3)
+    phases = _phases(s, 20)
+    first_done = phases.index("done")
+    assert first_done == 2 + (1 + 0 + 1) * 3
+    assert set(phases[first_done:]) == {"done"}
+
+
+def test_schedule_repeat_zero_never_exhausts():
+    s = ProfileSchedule(skip_first=0, wait=1, warmup=1, active=1, repeat=0)
+    phases = _phases(s, 30)
+    assert "done" not in phases
+    assert phases[:3] == ["wait", "trace", "trace"]
+    assert phases[3:6] == ["wait", "trace", "trace"]   # wraps forever
+
+
+def test_schedule_skip_first_boundary():
+    s = ProfileSchedule(skip_first=3, wait=2, warmup=1, active=1, repeat=1)
+    assert _phases(s, 3) == ["skip"] * 3
+    assert s.phase(3) == "wait" and s.phase(4) == "wait"
+    assert s.phase(5) == "trace" and s.phase(6) == "trace"
+    assert s.phase(7) == "done"
+
+
+# ------------------------------------------------------------- Profiler
+
+class _TraceStub:
+    """Stands in for jax.profiler.start_trace/stop_trace."""
+
+    def __init__(self):
+        self.calls = []
+
+    def start(self, trace_dir):
+        self.calls.append(("start", trace_dir))
+
+    def stop(self):
+        self.calls.append(("stop",))
+
+
+@pytest.fixture
+def trace_stub(monkeypatch, tmp_path):
+    stub = _TraceStub()
+    import jax
+    monkeypatch.setattr(jax.profiler, "start_trace", stub.start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", stub.stop)
+    return stub
+
+
+def test_profiler_starts_and_stops_at_exact_transitions(trace_stub,
+                                                        tmp_path):
+    # Profiler.step() is called AFTER each training step; it evaluates the
+    # phase of the NEXT step index (self._step is pre-incremented)
+    sched = ProfileSchedule(skip_first=0, wait=2, warmup=1, active=2,
+                            repeat=1)
+    p = Profiler(trace_dir=str(tmp_path), schedule=sched, enabled=True)
+    transitions = []
+    for i in range(8):
+        before = len(trace_stub.calls)
+        p.step()
+        for c in trace_stub.calls[before:]:
+            transitions.append((i, c[0]))
+    # phases by next-step index: 1 wait, 2 trace(warmup), 3-4 trace(active),
+    # 5 done -> start fires at loop i=1 (entering step idx 2), stop at i=4
+    assert transitions == [(1, "start"), (4, "stop")]
+
+
+def test_profiler_repeat_cycles_restart_tracing(trace_stub, tmp_path):
+    sched = ProfileSchedule(skip_first=0, wait=1, warmup=1, active=1,
+                            repeat=2)
+    p = Profiler(trace_dir=str(tmp_path), schedule=sched, enabled=True)
+    for _ in range(10):
+        p.step()
+    kinds = [c[0] for c in trace_stub.calls]
+    # two trace windows -> two start/stop pairs, properly interleaved
+    assert kinds == ["start", "stop", "start", "stop"]
+
+
+def test_profiler_stop_flushes_inflight_trace(trace_stub, tmp_path):
+    sched = ProfileSchedule(skip_first=0, wait=0, warmup=1, active=5,
+                            repeat=1)
+    p = Profiler(trace_dir=str(tmp_path), schedule=sched, enabled=True)
+    p.step()   # enters trace immediately (wait=0)
+    assert [c[0] for c in trace_stub.calls] == ["start"]
+    p.stop()
+    assert [c[0] for c in trace_stub.calls] == ["start", "stop"]
+    p.stop()   # idempotent
+    assert [c[0] for c in trace_stub.calls] == ["start", "stop"]
+
+
+def test_profiler_context_manager_stops_on_exception(trace_stub, tmp_path):
+    sched = ProfileSchedule(skip_first=0, wait=0, warmup=1, active=5,
+                            repeat=1)
+    with pytest.raises(ValueError):
+        with Profiler(trace_dir=str(tmp_path), schedule=sched,
+                      enabled=True) as p:
+            p.step()
+            raise ValueError("boom")
+    assert [c[0] for c in trace_stub.calls] == ["start", "stop"]
+
+
+def test_profiler_disabled_never_touches_jax(trace_stub, tmp_path):
+    p = Profiler(trace_dir=str(tmp_path), enabled=False)
+    for _ in range(20):
+        p.step()
+    p.stop()
+    assert trace_stub.calls == []

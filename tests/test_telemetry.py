@@ -1,0 +1,262 @@
+"""Unified run telemetry: manifest/steps/summary layout, crash safety,
+the report CLI, and the end-to-end smoke leg (a 3-step ddp toy run on the
+CPU-sim mesh reported back through ``scripts/report.py``)."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from distributed_training_sandbox_tpu.telemetry import (
+    MetricsWriter, RunManifest, TelemetryRun, step_event)
+from distributed_training_sandbox_tpu.telemetry import report as R
+from distributed_training_sandbox_tpu.telemetry.schema import validate_step
+
+
+# --------------------------------------------------------------- schema
+
+def test_step_event_lifts_tracker_metrics():
+    ev = step_event(3, loss=1.5, tokens=64, tracker_metrics={
+        "tokens_per_second": 1000.0, "tflops_per_device": 2.5,
+        "peak_memory_gb": 1.25, "last_step_time_s": 0.01})
+    assert ev["step"] == 3 and ev["loss"] == 1.5 and ev["tokens"] == 64
+    assert ev["tokens_per_second"] == 1000.0
+    assert ev["tflops_per_device"] == 2.5
+    assert ev["peak_memory_gb"] == 1.25
+    assert ev["step_time_s"] == 0.01
+    assert validate_step(ev) == []
+
+
+def test_step_event_explicit_time_wins_and_nulls_allowed():
+    ev = step_event(0, step_time_s=0.5,
+                    tracker_metrics={"last_step_time_s": 0.1})
+    assert ev["step_time_s"] == 0.5
+    assert ev["loss"] is None and validate_step(ev) == []
+
+
+def test_validate_step_flags_problems():
+    assert any("schema" in p for p in validate_step({"step": 1}))
+    assert validate_step({"schema": 99, "step": 0})  # unknown version
+    assert any("loss" in p for p in
+               validate_step({"step": 0, "loss": "nan-string"}))
+    assert any("step" in p for p in validate_step({"schema": 1}))
+
+
+# ----------------------------------------------------- manifest + writer
+
+def test_manifest_captures_environment(mesh8):
+    from distributed_training_sandbox_tpu.utils import TrainConfig
+    cfg = TrainConfig(num_steps=3, batch_size=16)
+    man = RunManifest.capture("ddp", run_id="r1", config=cfg, mesh=mesh8,
+                              model="mlp",
+                              collective_counts={"all_reduce": 3,
+                                                 "total": 3})
+    d = man.to_dict()
+    assert d["strategy"] == "ddp" and d["run_id"] == "r1"
+    assert d["mesh_shape"] == {"dp": 8} and d["mesh_axes"] == ["dp"]
+    assert d["device_count"] == 8 and d["platform"] == "cpu"
+    assert d["config"]["batch_size"] == 16
+    assert d["collective_counts"]["total"] == 3
+    assert d["jax_version"]
+
+
+def test_writer_layout(tmp_path):
+    w = MetricsWriter(str(tmp_path / "run1"))
+    w.write_manifest({"run_id": "run1"})
+    w.append_step(step_event(0, loss=1.0))
+    w.append_step(step_event(1, loss=0.9))
+    w.write_summary({"status": "completed"})
+    w.close()
+    d = tmp_path / "run1"
+    assert json.load(open(d / "manifest.json"))["run_id"] == "run1"
+    lines = [json.loads(line) for line in open(d / "steps.jsonl")]
+    assert [line["step"] for line in lines] == [0, 1]
+    assert json.load(open(d / "summary.json"))["status"] == "completed"
+
+
+# ----------------------------------------------------------- TelemetryRun
+
+def test_telemetry_run_happy_path(tmp_path, mesh8):
+    with TelemetryRun("toy", mesh=mesh8, results_dir=str(tmp_path),
+                      enabled=True) as telem:
+        for i in range(4):
+            telem.step(loss=1.0 - 0.1 * i, tokens=32)
+    files = sorted(os.listdir(telem.run_dir))
+    assert files == ["manifest.json", "steps.jsonl", "summary.json"]
+    summ = json.load(open(os.path.join(telem.run_dir, "summary.json")))
+    assert summ["status"] == "completed"
+    assert summ["steps_recorded"] == 4
+    assert summ["total_tokens"] == 128
+    assert summ["final_loss"] == pytest.approx(0.7)
+    assert summ["step_time_ms"] > 0
+
+
+class _StubProfiler:
+    """Counts stop() calls; `enabled` False keeps the trace-split hook off."""
+    enabled = False
+    trace_dir = "unused"
+
+    def __init__(self):
+        self.steps = 0
+        self.stops = 0
+
+    def step(self):
+        self.steps += 1
+
+    def stop(self):
+        self.stops += 1
+
+
+def test_telemetry_run_crash_flushes_profiler_and_summary(tmp_path):
+    prof = _StubProfiler()
+    with pytest.raises(RuntimeError):
+        with TelemetryRun("toy", results_dir=str(tmp_path),
+                          profiler=prof, enabled=True) as telem:
+            telem.step(loss=2.0)
+            raise RuntimeError("mid-loop death")
+    # the in-flight trace was flushed even though the loop died
+    assert prof.stops == 1
+    summ = json.load(open(os.path.join(telem.run_dir, "summary.json")))
+    assert summ["status"] == "crashed"
+    assert "mid-loop death" in summ["error"]
+    # the step written before the crash survived
+    steps = [json.loads(line) for line in
+             open(os.path.join(telem.run_dir, "steps.jsonl"))]
+    assert len(steps) == 1 and steps[0]["loss"] == 2.0
+
+
+def test_telemetry_run_disabled_writes_nothing_but_drives_profiler(tmp_path):
+    prof = _StubProfiler()
+    with TelemetryRun("toy", results_dir=str(tmp_path), profiler=prof,
+                      enabled=False) as telem:
+        telem.step(loss=1.0)
+    assert telem.run_dir is None
+    assert os.listdir(tmp_path) == []
+    # profiling is orthogonal to telemetry: still stepped and stopped
+    assert prof.steps == 1 and prof.stops == 1
+
+
+def test_run_id_collisions_get_suffixed(tmp_path):
+    a = TelemetryRun("x", results_dir=str(tmp_path), enabled=True).start()
+    a.finalize()
+    b = TelemetryRun("x", results_dir=str(tmp_path), enabled=True).start()
+    b.finalize()
+    assert a.run_id != b.run_id
+    assert len(os.listdir(tmp_path)) == 2
+
+
+# ------------------------------------------------------- report library
+
+def _fake_run(root, run_id, strategy, step_ms, toks, model="mlp",
+              seq=128, batch=32):
+    d = os.path.join(root, run_id)
+    w = MetricsWriter(d)
+    w.write_manifest({"run_id": run_id, "strategy": strategy,
+                      "model": model, "device_count": 8,
+                      "platform": "cpu",
+                      "config": {"sequence_length": seq,
+                                 "batch_size": batch},
+                      "collective_counts": {"total": 14}})
+    w.append_step(step_event(0, loss=1.0))
+    w.write_summary({"run_id": run_id, "strategy": strategy,
+                     "model": model, "status": "completed",
+                     "sequence_length": seq, "batch_size": batch,
+                     "step_time_ms": step_ms,
+                     "tokens_per_second": toks})
+    w.close()
+    return d
+
+
+def test_discover_and_render(tmp_path):
+    _fake_run(str(tmp_path), "r1-ddp", "ddp", 10.0, 1000.0)
+    _fake_run(str(tmp_path), "r2-fsdp", "fsdp", 20.0, 2000.0)
+    recs = R.discover_runs([str(tmp_path)])
+    assert len(recs) == 2
+    rows = [R.run_row(rec) for rec in recs]
+    table = R.render_table(rows)
+    assert "ddp" in table and "fsdp" in table
+    assert "10.00" in table and "2000" in table
+    assert "| 14 |" in table          # collectives column
+
+
+def test_regression_check_self_passes_and_injected_fails(tmp_path):
+    _fake_run(str(tmp_path), "r1-ddp", "ddp", 10.0, 1000.0)
+    rows = [R.run_row(rec) for rec in R.discover_runs([str(tmp_path)])]
+    ok = R.check_regressions(rows, copy.deepcopy(rows), tolerance=0.15)
+    assert ok and not any(c["regressed"] for c in ok)
+    # baseline was 2x faster -> current is +100% step time: regression
+    base = copy.deepcopy(rows)
+    base[0]["step_time_ms"] = 5.0
+    bad = R.check_regressions(rows, base, tolerance=0.15)
+    assert any(c["regressed"] and c["metric"] == "step_time_ms"
+               for c in bad)
+
+
+def test_no_cross_strategy_matching(tmp_path):
+    _fake_run(str(tmp_path), "r1-ddp", "ddp", 10.0, 1000.0)
+    _fake_run(str(tmp_path), "r2-fsdp", "fsdp", 99.0, 10.0)
+    rows = [R.run_row(rec) for rec in R.discover_runs([str(tmp_path)])]
+    res = R.check_regressions(rows, copy.deepcopy(rows), tolerance=0.15)
+    # ddp must never be judged against the fsdp baseline
+    assert res and all(c["run_id"] == c["baseline"] for c in res)
+
+
+def test_baseline_from_bench_style_json(tmp_path):
+    rows = [{"config": "explicit", "model": "tiny", "seq_len": 64,
+             "batch": 8, "tokens_per_sec": 500.0, "step_ms": 12.0}]
+    f = tmp_path / "bench.json"
+    json.dump({"matrix": rows}, open(f, "w"))
+    base = R.load_baseline_rows(str(f))
+    assert base[0]["sequence_length"] == 64
+    assert base[0]["tokens_per_second"] == 500.0
+    assert base[0]["step_time_ms"] == 12.0
+
+
+def test_baseline_from_bench_tail_artifact(tmp_path):
+    tail = ('garbage [{"config": "a", "tokens_per_sec": 10.0}, '
+            '{"config": "b", "error": "oom"}] trailing {"not": "a row"}')
+    f = tmp_path / "BENCH_r99.json"
+    json.dump({"n": 99, "tail": tail}, open(f, "w"))
+    base = R.load_baseline_rows(str(f))
+    assert [r["config"] for r in base] == ["a"]
+
+
+# --------------------------------------------- end-to-end smoke (CI leg)
+
+def test_ddp_toy_leg_telemetry_and_report_roundtrip(tmp_path):
+    """The ISSUE's CI smoke: a 3-step ddp toy leg on CPU with telemetry
+    into a tmpdir, then scripts/report.py over it — the table renders and
+    the regression check against itself passes; an injected step-time
+    regression flips the exit code."""
+    from scripts.ddp import main as ddp_main
+    from scripts.report import main as report_main
+
+    results = tmp_path / "runs"
+    m = ddp_main(["--num-steps", "3", "--no-profile",
+                  "--results-dir", str(results)])
+    assert m is not None
+    run_dirs = sorted(results.iterdir())
+    assert len(run_dirs) == 1
+    for f in ("manifest.json", "steps.jsonl", "summary.json"):
+        assert (run_dirs[0] / f).is_file(), f
+    steps = [json.loads(line) for line in open(run_dirs[0] / "steps.jsonl")]
+    assert len(steps) == 3
+    assert all(validate_step(ev) == [] for ev in steps)
+
+    # report renders and the self-baseline passes
+    rc = report_main([str(results), "--baseline", str(results),
+                      "--strict"])
+    assert rc == 0
+
+    # inject a >tolerance step-time regression into a baseline copy
+    baseline = tmp_path / "baseline"
+    import shutil
+    shutil.copytree(results, baseline)
+    summ_f = next(baseline.iterdir()) / "summary.json"
+    summ = json.load(open(summ_f))
+    summ["step_time_ms"] /= 3.0        # baseline 3x faster than current
+    json.dump(summ, open(summ_f, "w"))
+    rc = report_main([str(results), "--baseline", str(baseline),
+                      "--tolerance", "0.5"])
+    assert rc == 1

@@ -62,6 +62,79 @@ def test_no_trace_returns_none(tmp_path):
     assert split_from_trace(str(tmp_path)) is None
 
 
+def test_classifier_precedence_comm_beats_compute(tmp_path):
+    """Every HLO collective whose name also matches the compute regex
+    ('gather'/'reduce'/'scatter' appear there too) must land in comm —
+    comm is checked first, the classifier's load-bearing order."""
+    _write_trace(tmp_path, [
+        _ev("all-gather-start.1", 11), _ev("reduce-scatter.7", 13),
+        _ev("all-reduce-done.2", 17), _ev("all_to_all.3", 19),
+        # pure compute controls
+        _ev("gather.9", 100), _ev("reduce.4", 100), _ev("scatter.8", 100),
+    ])
+    sp = split_from_trace(str(tmp_path))
+    assert sp.comm_us == 11 + 13 + 17 + 19
+    assert sp.compute_us == 300
+
+
+def test_ignore_events_stay_out_of_denominator(tmp_path):
+    """_IGNORE infra events are excluded from BOTH buckets and from the
+    comm-fraction denominator, even when their names would also match the
+    compute regex (e.g. 'shard_arg copy' contains 'copy')."""
+    _write_trace(tmp_path, [
+        _ev("all-reduce.1", 100), _ev("fusion.2", 100),
+        _ev("Wait: pending_threads", 1000),
+        _ev("shard_arg copy", 1000),          # 'copy' is in _COMPUTE
+        _ev("PjRtStreamExecutor dispatch", 1000),
+        _ev("$async-wrapper", 1000),
+        _ev("process_name", 1000),
+    ])
+    sp = split_from_trace(str(tmp_path))
+    assert sp.comm_us == 100 and sp.compute_us == 100
+    assert sp.comm_fraction == 0.5
+    assert sp.total_us == 200              # denominator excludes infra
+
+
+def test_collective_stall_events_beat_ignore(tmp_path):
+    """Rendezvous (CPU collective stall) and megacore-fusion-wait (TPU)
+    must classify as comm even though _IGNORE's generic 'Wait' pattern
+    also matches — comm-first ordering again, per the methodology note."""
+    _write_trace(tmp_path, [
+        _ev("megacore-fusion-wait.3", 40),
+        _ev("Rendezvous", 60),
+        _ev("dot.1", 100),
+    ])
+    sp = split_from_trace(str(tmp_path))
+    assert sp.comm_us == 100
+    assert sp.compute_us == 100
+
+
+def test_rendezvous_callback_is_infra_not_comm(tmp_path):
+    """The negative lookahead: 'rendezvous callback' is host infra, only
+    bare 'Rendezvous' is a collective stall."""
+    _write_trace(tmp_path, [
+        _ev("rendezvous callback", 500),
+        _ev("Rendezvous", 25),
+        _ev("fusion.1", 75),
+    ])
+    sp = split_from_trace(str(tmp_path))
+    assert sp.comm_us == 25
+    assert sp.compute_us == 75
+    assert sp.total_us == 100
+
+
+def test_non_duration_events_skipped(tmp_path):
+    """Only ph == 'X' complete events count; metadata/instant events with
+    matching names must not pollute the split."""
+    _write_trace(tmp_path, [
+        {"ph": "M", "name": "all-reduce.1", "dur": 999},
+        {"ph": "i", "name": "fusion.1", "dur": 999},
+        _ev("all-reduce.2", 10), _ev("fusion.2", 30),
+    ])
+    sp = split_from_trace(str(tmp_path))
+    assert sp.comm_us == 10 and sp.compute_us == 30
+
+
 def test_split_from_real_trace(tmp_path, mesh8):
     """End-to-end: trace a collective-heavy jit and recover a split with
     nonzero comm."""
