@@ -1,0 +1,38 @@
+"""Static analysis of sharding & collective choreography.
+
+The north star demands each strategy "replays the same collective
+choreography" as the reference scripts.  This package makes that a
+machine-checked contract instead of a spot-checked print:
+
+  * ``contracts``  — one declarative :class:`CollectiveContract` per
+    strategy (expected collective site counts per step, allowed mesh
+    axes, approximate payload bytes), checked against
+    ``ops.hlo.count_collectives`` of the lowered step;
+  * ``hlo_lint``   — lint passes over *compiled* HLO text: accidental
+    full-param replication (unexpected all-gather of a full param
+    shape), missing input/output buffer aliasing where donation was
+    requested, host transfers, and collectives whose replica groups
+    don't correspond to any declared mesh axis;
+  * ``recompile``  — retrace counter over a step-function window;
+    recompiles after the first executed step fail;
+  * ``pitfalls``   — AST-level lint of ``scripts/`` for classic JAX
+    performance traps (hot jnp ops in Python loops outside jit,
+    collectives outside shard_map, train-step jits without donation);
+  * ``fixtures``   — tiny CPU-mesh builds of every strategy's train
+    step, shared by the contract pytest suite and the lint CLI.
+
+Entry point: ``scripts/lint_sharding.py`` (exit nonzero on violation,
+``--json`` report); per-run verdicts land in telemetry ``manifest.json``.
+"""
+
+from .contracts import (  # noqa: F401
+    CONTRACTS,
+    CollectiveContract,
+    ContractContext,
+    ContractVerdict,
+    check_counts,
+    evaluate_contract,
+)
+from .hlo_lint import LintFinding, lint_compiled_hlo  # noqa: F401
+from .recompile import RecompileReport, watch_recompiles  # noqa: F401
+from .pitfalls import PitfallFinding, lint_file, lint_tree  # noqa: F401

@@ -1,0 +1,263 @@
+"""Per-strategy collective choreography contracts.
+
+A :class:`CollectiveContract` states, declaratively, what one optimizer
+step of a strategy is allowed to put on the wire: which collective kinds
+appear at how many *sites* in the lowered StableHLO, over which mesh
+axes, and roughly how many bytes.  The counts are **site counts** — the
+number the tests and every script's startup print already compute via
+``ops.hlo.count_collectives`` — so a ``lax.scan`` over layers contributes
+its body's collectives once regardless of depth (that is also why the
+counts are stable across model sizes of the same family).
+
+The formulas mirror the reference's prose collective accounting
+(reference ``README.md:16-20``: "+60 all_reduce +60 broadcast" for 12
+params × 5 steps of ZeRO-1) but are evaluated mechanically: a refactor
+that silently replicates a sharded param (an extra all-gather) or drops
+a reduce-scatter fails the contract instead of drifting by eye.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+KINDS = ("all_reduce", "all_gather", "reduce_scatter",
+         "collective_permute", "all_to_all")
+
+
+def _tree_stats(params) -> tuple[int, int]:
+    """(leaf count, total param bytes) of a pytree of arrays."""
+    import jax
+    leaves = [l for l in jax.tree.leaves(params) if hasattr(l, "shape")]
+    nbytes = sum(math.prod(l.shape) * getattr(l.dtype, "itemsize", 4)
+                 for l in leaves)
+    return len(leaves), int(nbytes)
+
+
+@dataclass(frozen=True)
+class ContractContext:
+    """Everything a contract formula may depend on, captured from the run
+    being checked: world size, mesh axis sizes, parameter tree stats and
+    strategy knobs (``extra`` — e.g. the ZeRO rebuild mode)."""
+    ws: int = 1
+    axis_sizes: Mapping[str, int] = field(default_factory=dict)
+    n_leaves: int = 0
+    n_layers: int = 0
+    param_bytes: int = 0
+    extra: Mapping[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def capture(cls, *, params=None, mesh=None, n_layers: int = 0,
+                **extra) -> "ContractContext":
+        n_leaves = param_bytes = 0
+        if params is not None:
+            n_leaves, param_bytes = _tree_stats(params)
+        axis_sizes = dict(mesh.shape) if mesh is not None else {}
+        ws = int(math.prod(axis_sizes.values())) if axis_sizes else 1
+        return cls(ws=ws, axis_sizes=axis_sizes, n_leaves=n_leaves,
+                   n_layers=n_layers, param_bytes=param_bytes, extra=extra)
+
+
+@dataclass(frozen=True)
+class CollectiveContract:
+    """Declarative choreography of one strategy's train step.
+
+    ``counts(ctx)`` maps collective kind -> expected StableHLO site
+    count: an int (exact), a ``(lo, hi)`` range (inclusive), or None
+    (unchecked).  Kinds missing from the dict are expected to be 0.
+    ``axes``: the mesh axes this strategy's collectives may span —
+    the replica-group check in ``hlo_lint`` enforces it on compiled HLO.
+    ``allows_full_param_gather``: strategies that materialize full params
+    by design (ZeRO-3 / FSDP / SP) — exempt from the replication lint.
+    ``payload_bytes(ctx)``: approximate per-step bytes on the wire, for
+    the manifest / report (informational, never asserted)."""
+    strategy: str
+    axes: tuple[str, ...]
+    counts: Callable[[ContractContext], dict]
+    allows_full_param_gather: bool = False
+    payload_bytes: Callable[[ContractContext], int] | None = None
+    description: str = ""
+
+
+# ---------------------------------------------------------------- registry
+#
+# Calibrated against the lowered steps of the in-repo factories (see
+# tests/test_contracts.py, which re-derives several of these by lowering
+# on the CPU mesh).  n = param leaf count throughout.
+
+def _zero1_counts(c: ContractContext) -> dict:
+    if c.extra.get("rebuild", "broadcast") == "all_gather":
+        return {"all_reduce": c.n_leaves + 2, "all_gather": c.n_leaves}
+    # masked-psum rebuild: the wire twin of per-param dist.broadcast
+    return {"all_reduce": 2 * c.n_leaves + 2}
+
+
+def _zero2_counts(c: ContractContext) -> dict:
+    if c.extra.get("rebuild", "broadcast") == "all_gather":
+        return {"all_reduce": 2, "all_gather": c.n_leaves,
+                "reduce_scatter": c.n_leaves}
+    return {"all_reduce": c.n_leaves + 2, "reduce_scatter": c.n_leaves}
+
+
+CONTRACTS: dict[str, CollectiveContract] = {
+    # per-param grad all_reduce + loss mean + step barrier (DDP/ddp.py:43-47)
+    "ddp": CollectiveContract(
+        "ddp", ("dp",),
+        lambda c: {"all_reduce": c.n_leaves + 2},
+        payload_bytes=lambda c: 2 * c.param_bytes,
+        description="per-param grad all_reduce; no gathers (params "
+                    "replicated at rest)"),
+    # grads all_reduced per param, owner-chunk Adam, per-param rebuild
+    "zero1": CollectiveContract(
+        "zero1", ("dp",), _zero1_counts,
+        payload_bytes=lambda c: 3 * c.param_bytes,
+        description="n grad all_reduces + n param rebuilds "
+                    "(the reference's 60+60 per 5 steps) + loss + barrier"),
+    # grads reduce_scattered straight to the chunk (zero2.py:94-115)
+    "zero2": CollectiveContract(
+        "zero2", ("dp",), _zero2_counts,
+        payload_bytes=lambda c: 3 * c.param_bytes,
+        description="n grad reduce_scatters + n param rebuilds + loss + "
+                    "barrier"),
+    # params sharded at rest; per-layer materialize in fwd AND remat'd bwd
+    # (zero3.py:56-77).  Sites: n fwd gathers + (n-1) bwd re-gathers — the
+    # last layer's bias needs no recompute (no ReLU mask after it), so its
+    # backward gather is dead-code-eliminated.  Grads arrive through the
+    # all_gather transpose: one psum_scatter per param.
+    "zero3": CollectiveContract(
+        "zero3", ("dp",),
+        lambda c: {"all_reduce": 2,
+                   "all_gather": 2 * c.n_leaves - 1,
+                   "reduce_scatter": c.n_leaves},
+        allows_full_param_gather=True,
+        payload_bytes=lambda c: 3 * c.param_bytes,
+        description="per-layer fwd+bwd all_gathers, psum_scatter grads, "
+                    "loss + barrier"),
+    # per-leaf gather around compute (scan body: one site per stacked
+    # leaf), reduce-scatter transposes, one loss mean (no barrier)
+    "fsdp": CollectiveContract(
+        "fsdp", ("dp",),
+        lambda c: {"all_reduce": 1,
+                   "all_gather": c.n_leaves,
+                   "reduce_scatter": c.n_leaves},
+        allows_full_param_gather=True,
+        payload_bytes=lambda c: 3 * c.param_bytes,
+        description="one gather + one reduce-scatter site per param leaf "
+                    "(scan collapses depth), one loss pmean"),
+    # Megatron TP: activations psum'd in the layer body (2/layer-site),
+    # grads psum'd per replicated leaf; NO param gathers or scatters —
+    # an all_gather here means a param silently went dp-replicated.
+    "tp": CollectiveContract(
+        "tp", ("dp", "tp"),
+        lambda c: {"all_reduce": (c.n_leaves + 2, c.n_leaves + 8)},
+        payload_bytes=None,
+        description="activation psums + per-leaf grad psums only; any "
+                    "gather/scatter site is a choreography break"),
+    # FSDP over dp × ring attention over sp: fsdp sites + the KV ring's
+    # collective_permutes (k and v, forward + backward = 4 sites) + per-
+    # leaf sp grad psums (params are sp-replicated)
+    "sp": CollectiveContract(
+        "sp", ("dp", "sp"),
+        lambda c: {"all_reduce": c.n_leaves + 2,
+                   "all_gather": c.n_leaves,
+                   "reduce_scatter": c.n_leaves,
+                   "collective_permute": 4},
+        allows_full_param_gather=True,
+        payload_bytes=None,
+        description="fsdp choreography + 4 KV-ring ppermute sites + sp "
+                    "grad psums"),
+    # switch-MoE: a2a dispatch + return in the scanned layer body, each
+    # with its backward transpose (4 sites); dense/router grads psum'd
+    "moe": CollectiveContract(
+        "moe", ("dp", "ep"),
+        lambda c: {"all_reduce": (c.n_leaves + 2, c.n_leaves + 8),
+                   "all_to_all": 4},
+        payload_bytes=None,
+        description="4 all_to_all sites (dispatch/return × fwd/bwd) + "
+                    "per-leaf grad psums; gathers/scatters forbidden"),
+    # pipeline stages are single-device jitted programs; inter-stage comm
+    # is host-mediated device transfer, never a mesh collective
+    "gpipe": CollectiveContract(
+        "gpipe", (), lambda c: {},
+        description="stage programs carry zero collectives"),
+    "1f1b": CollectiveContract(
+        "1f1b", (), lambda c: {},
+        description="stage programs carry zero collectives"),
+}
+
+
+# ---------------------------------------------------------------- checking
+
+@dataclass
+class ContractVerdict:
+    """Outcome of checking observed counts against one contract."""
+    strategy: str
+    ok: bool
+    expected: dict
+    observed: dict
+    violations: list[str]
+    payload_bytes: int | None = None
+
+    def summary(self) -> str:
+        if self.ok:
+            seen = ", ".join(f"{k}={v}" for k, v in
+                             sorted(self.observed.items()) if v)
+            return f"OK ({seen})" if seen else "OK (no collectives)"
+        return "VIOLATED: " + "; ".join(self.violations)
+
+    def to_dict(self) -> dict:
+        return {"strategy": self.strategy, "ok": self.ok,
+                "expected": self.expected, "observed": self.observed,
+                "violations": self.violations,
+                "payload_bytes": self.payload_bytes}
+
+
+def check_counts(contract: CollectiveContract, observed: Mapping[str, int],
+                 ctx: ContractContext) -> ContractVerdict:
+    """Compare ``count_collectives``-style observed counts against the
+    contract's expectation for ``ctx``.  Kinds the contract omits must be
+    0; int expectations are exact; ``(lo, hi)`` inclusive; None skipped."""
+    expected = dict(contract.counts(ctx))
+    violations = []
+    exp_out = {}
+    for kind in KINDS:
+        want = expected.get(kind, 0)
+        got = int(observed.get(kind, 0))
+        if want is None:
+            exp_out[kind] = "any"
+            continue
+        if isinstance(want, tuple):
+            lo, hi = want
+            exp_out[kind] = f"{lo}..{hi}"
+            if not lo <= got <= hi:
+                violations.append(
+                    f"{kind}: {got} sites, contract allows {lo}..{hi}")
+        else:
+            exp_out[kind] = int(want)
+            if got != want:
+                violations.append(
+                    f"{kind}: {got} sites, contract expects {want}")
+    payload = (int(contract.payload_bytes(ctx))
+               if contract.payload_bytes else None)
+    obs = {k: int(observed.get(k, 0)) for k in KINDS}
+    return ContractVerdict(strategy=contract.strategy,
+                           ok=not violations, expected=exp_out,
+                           observed=obs, violations=violations,
+                           payload_bytes=payload)
+
+
+def evaluate_contract(strategy: str, observed: Mapping[str, int], *,
+                      params=None, mesh=None, n_layers: int = 0,
+                      ctx: ContractContext | None = None,
+                      **extra) -> ContractVerdict:
+    """One-call form the strategy scripts use: look up the registry,
+    capture a context from the live params/mesh, check the counts they
+    already computed for their startup print."""
+    if strategy not in CONTRACTS:
+        raise KeyError(f"no contract registered for {strategy!r}; "
+                       f"have {sorted(CONTRACTS)}")
+    if ctx is None:
+        ctx = ContractContext.capture(params=params, mesh=mesh,
+                                      n_layers=n_layers, **extra)
+    return check_counts(CONTRACTS[strategy], observed, ctx)

@@ -1,0 +1,155 @@
+"""Tiny CPU-mesh builds of every strategy's train step.
+
+One place that knows how to construct a minimal, fast instance of each
+strategy exactly the way its ``scripts/`` driver does — shared by the
+contract pytest suite and ``scripts/lint_sharding.py`` so "lower the
+step and check the choreography" is a one-liner everywhere.
+
+Everything here is CPU-sized: toy-MLP widths of ~100 and the TINY_LM
+transformer at sequence length 32, so the full registry lowers, lints
+and runs 3 steps in well under a minute on the 8-device simulated mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses as _dc
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from .contracts import CONTRACTS, ContractContext
+
+STRATEGIES = ("ddp", "zero1", "zero2", "zero3", "fsdp", "tp", "sp",
+              "moe", "gpipe", "1f1b")
+
+
+@dataclass
+class StrategyBuild:
+    """A lowered-and-runnable strategy instance plus everything the
+    analyzers need to judge it."""
+    strategy: str
+    step: Callable                    # jitted step fn
+    args: tuple                       # example invocation args
+    advance: Callable | None          # (args, outputs) -> next args
+    mesh: Any                         # jax Mesh or None (pipeline)
+    ctx: ContractContext
+    donate: bool
+    full_param_shapes: set = field(default_factory=set)
+
+    @property
+    def contract(self):
+        return CONTRACTS[self.strategy]
+
+
+def _state_advance(args, out):
+    """(params, opt, batch) step contract: feed state back, reuse batch."""
+    params, opt, loss = out
+    return (params, opt, args[2])
+
+
+def build_strategy(strategy: str, *, mesh=None, scale: int = 100,
+                   seq: int = 32, batch_size: int = 8) -> StrategyBuild:
+    """Construct the named strategy's step the way its script does.
+
+    ``mesh`` defaults to a fresh mesh of the canonical shape for that
+    strategy over all visible devices (1-D ``dp``, or ``{dp: n/2, x: 2}``
+    for the 2-D strategies)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import transformer as T
+    from ..models import zero_toy_mlp, pp_toy_mlp
+    from ..models.mlp import mse_loss, PP_TOY_SIZES
+    from ..parallel import fsdp, optim, sequence, tensor, expert
+    from ..parallel import make_ddp_train_step
+    from ..parallel.zero import (
+        make_zero_train_step, init_zero_opt_state, make_zero3_train_step,
+        make_zero3_mlp_loss, shard_params_zero3)
+    from ..utils import make_mesh, set_seed
+    from .hlo_lint import param_shapes
+
+    if strategy not in STRATEGIES:
+        raise KeyError(f"unknown strategy {strategy!r}; have {STRATEGIES}")
+    key = set_seed(0)
+    n_dev = len(jax.devices())
+
+    # ---- toy-MLP strategies over a 1-D dp mesh -------------------------
+    if strategy in ("ddp", "zero1", "zero2", "zero3"):
+        mesh = mesh or make_mesh(register=False)
+        params = zero_toy_mlp(key, scale=scale)
+        width = 10_000 // scale
+        kx, ky = jax.random.split(key)
+        b = (jax.random.normal(kx, (batch_size, width)),
+             jax.random.normal(ky, (batch_size, width)))
+        shapes = param_shapes(params, min_numel=256)
+        ctx = ContractContext.capture(params=params, mesh=mesh,
+                                      n_layers=len(params))
+        if strategy == "ddp":
+            step = make_ddp_train_step(
+                mse_loss,
+                lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3),
+                mesh, "dp")
+            args = (params, optim.sgd_init(params), b)
+        elif strategy in ("zero1", "zero2"):
+            step = make_zero_train_step(mse_loss, mesh, "dp",
+                                        stage=int(strategy[-1]))
+            args = (params, init_zero_opt_state(params, mesh, "dp"), b)
+        else:
+            layer_shapes = [{k: v.shape for k, v in layer.items()}
+                            for layer in params]
+            step = make_zero3_train_step(
+                make_zero3_mlp_loss(layer_shapes, "dp"), mesh, "dp")
+            args = (shard_params_zero3(params, mesh, "dp"),
+                    init_zero_opt_state(params, mesh, "dp"), b)
+        return StrategyBuild(strategy, step, args, _state_advance, mesh,
+                             ctx, donate=True, full_param_shapes=shapes)
+
+    # ---- transformer strategies ----------------------------------------
+    if strategy in ("fsdp", "tp", "sp", "moe"):
+        mcfg = T.TINY_LM
+        second_axis = {"fsdp": None, "tp": "tp", "sp": "sp",
+                       "moe": "ep"}[strategy]
+        if mesh is None:
+            if second_axis is None:
+                mesh = make_mesh(register=False)
+            else:
+                if n_dev < 4:
+                    raise RuntimeError(
+                        f"{strategy} fixture needs >= 4 devices "
+                        f"(have {n_dev})")
+                mesh = make_mesh({"dp": n_dev // 2, second_axis: 2},
+                                 register=False)
+        if strategy == "moe":
+            mcfg = _dc.replace(mcfg, n_experts=4,
+                               moe_ffn=max(mcfg.intermediate_size // 4, 8))
+        params = T.init_params(key, mcfg)
+        shapes = param_shapes(params, min_numel=1024)
+        ctx = ContractContext.capture(params=params, mesh=mesh,
+                                      n_layers=mcfg.num_hidden_layers)
+        if strategy == "fsdp":
+            shards = fsdp.shard_params_fsdp(params, mesh)
+            step = fsdp.make_fsdp_train_step(shards, mcfg, mesh)
+        elif strategy == "sp":
+            shards = fsdp.shard_params_fsdp(params, mesh, "dp")
+            step = sequence.make_sp_train_step(shards, mcfg, mesh)
+        elif strategy == "tp":
+            shards = tensor.shard_params_tp(params, mesh)
+            step = tensor.make_tp_train_step(shards, mcfg, mesh)
+        else:
+            shards = expert.shard_moe_lm_params(params, mesh)
+            step = expert.make_moe_lm_train_step(shards, mcfg, mesh)
+        opt = fsdp.init_fsdp_opt_state(shards)
+        probe = (jnp.zeros((batch_size, seq), jnp.int32),) * 2
+        return StrategyBuild(strategy, step, (shards, opt, probe),
+                             _state_advance, mesh, ctx, donate=True,
+                             full_param_shapes=shapes)
+
+    # ---- pipeline schedules: single-device stage programs --------------
+    from ..parallel.pipeline import build_pipeline
+    params = pp_toy_mlp(key)
+    stages = build_pipeline(params, 2)
+    x = jax.random.normal(key, (batch_size, PP_TOY_SIZES[0]))
+    ctx = ContractContext.capture(params=stages[0].params,
+                                  n_layers=len(params))
+    return StrategyBuild(strategy, stages[0].fwd,
+                         (stages[0].params, x),
+                         None, None, ctx, donate=False)

@@ -1,0 +1,187 @@
+"""Lint passes over compiled HLO text.
+
+Four checks, each catching one way a refactor silently breaks the
+sharding story without failing any numeric test:
+
+  * **replication** — an ``all-gather`` whose output is a full-parameter
+    shape means a sharded param is being materialized; for strategies
+    whose contract doesn't gather params (DDP, TP, ZeRO-1/2 broadcast
+    rebuild) that is a full extra copy of the weights on the wire every
+    step (the automatic-weight-update-sharding failure mode, PAPERS.md);
+  * **donation** — ``donate_argnums`` was requested but the compiled
+    module carries no ``input_output_alias`` entries: every step then
+    allocates fresh param/state buffers (2× resident memory);
+  * **host transfer** — ``MoveToHost``/``MoveToDevice`` custom calls or
+    ``S(5)``-space buffers inside a step function: a device→host sync
+    on the hot path;
+  * **foreign axis** — a collective whose replica groups match no
+    declared mesh axis combination: the op spans devices the strategy
+    never meant to couple (e.g. a psum leaking across ``tp`` in a
+    dp-only gradient sync).
+
+All checks are pure text analysis over ``lowered.compile().as_text()``
+— nothing executes, so they run on the CPU backend in CI against the
+same programs the TPU would run (module structure is backend-portable
+even though fusion details differ).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from itertools import combinations
+
+from ..ops.hlo import collective_instances
+
+SEV_ERROR = "error"
+SEV_WARN = "warn"
+
+_HOST_PATTERNS = (
+    r'custom_call_target="MoveToHost"',
+    r'custom_call_target="MoveToDevice"',
+    r'custom_call_target="annotate_device_placement"',
+    r"S\(5\)",  # host memory space in a layout annotation
+)
+
+
+@dataclass
+class LintFinding:
+    check: str          # "replication" | "donation" | "host_transfer"
+    #                     | "foreign_axis"
+    severity: str       # SEV_ERROR | SEV_WARN
+    message: str
+
+    def to_dict(self) -> dict:
+        return {"check": self.check, "severity": self.severity,
+                "message": self.message}
+
+
+def param_shapes(params, *, min_numel: int = 1024) -> set:
+    """The full (unsharded) shapes of a param tree, for the replication
+    check.  Tiny leaves (norm scales, biases) are skipped — gathering
+    those is noise, not a replication bug."""
+    import jax
+    return {tuple(l.shape) for l in jax.tree.leaves(params)
+            if hasattr(l, "shape") and math.prod(l.shape) >= min_numel}
+
+
+def mesh_axis_groupings(mesh) -> dict:
+    """frozenset(axis names) -> frozenset of device-id groups for every
+    non-empty axis subset of ``mesh`` — the universe of replica groups a
+    collective on this mesh may legally use."""
+    import numpy as np
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    names = list(mesh.axis_names)
+    out = {}
+    for r in range(1, len(names) + 1):
+        for subset in combinations(names, r):
+            axes = [names.index(a) for a in subset]
+            moved = np.moveaxis(ids, axes,
+                                range(ids.ndim - len(axes), ids.ndim))
+            size = int(np.prod([ids.shape[a] for a in axes]))
+            groups = frozenset(frozenset(int(i) for i in row)
+                               for row in moved.reshape(-1, size))
+            out[frozenset(subset)] = groups
+    return out
+
+
+# ---------------------------------------------------------------- checks
+
+def check_replication(instances, full_param_shapes, *,
+                      allow_full_param_gather: bool = False):
+    if allow_full_param_gather or not full_param_shapes:
+        return []
+    findings = []
+    for inst in instances:
+        if inst.kind != "all_gather":
+            continue
+        hits = [s for s in inst.shapes if tuple(s) in full_param_shapes]
+        for s in hits:
+            findings.append(LintFinding(
+                "replication", SEV_ERROR,
+                f"all-gather materializes full param shape {list(s)} "
+                f"({inst.bytes} B) — a sharded parameter is being "
+                f"replicated every step: {inst.line[:160]}"))
+    return findings
+
+
+def check_donation(text: str, *, donate_expected: bool):
+    if not donate_expected:
+        return []
+    # the alias map prints entries like "{0}: (0, {}, may-alias)" —
+    # presence of any may/must-alias entry means donation took
+    if re.search(r"input_output_alias=\{.*?(may|must)-alias", text):
+        return []
+    return [LintFinding(
+        "donation", SEV_ERROR,
+        "donate_argnums was requested but the compiled module has no "
+        "input_output_alias entries — params/opt-state buffers are "
+        "reallocated every step (2x resident memory)")]
+
+
+def check_host_transfers(text: str):
+    findings = []
+    for pat in _HOST_PATTERNS:
+        n = len(re.findall(pat, text))
+        if n:
+            findings.append(LintFinding(
+                "host_transfer", SEV_ERROR,
+                f"{n} host-transfer marker(s) matching /{pat}/ inside the "
+                f"step — device->host traffic on the hot path"))
+    return findings
+
+
+def check_replica_axes(instances, mesh, allowed_axes=None):
+    """Every collective's replica groups must equal the grouping of some
+    non-empty subset of ``allowed_axes`` (default: all mesh axes).
+    Unparseable groups are skipped (recorded nowhere — static analysis
+    stays best-effort); singleton groups are degenerate no-ops."""
+    if mesh is None:
+        return []
+    groupings = mesh_axis_groupings(mesh)
+    legal_by_subset = {}
+    allowed = (frozenset(allowed_axes) if allowed_axes is not None
+               else frozenset(mesh.axis_names))
+    for subset, groups in groupings.items():
+        if subset <= allowed:
+            legal_by_subset[groups] = subset
+    findings = []
+    for inst in instances:
+        if inst.replica_groups is None:
+            continue
+        if all(len(g) <= 1 for g in inst.replica_groups):
+            continue
+        observed = frozenset(frozenset(g) for g in inst.replica_groups)
+        if observed in legal_by_subset:
+            continue
+        # legal for the MESH but not for the DECLARED axes?
+        over = next((subset for subset, groups in groupings.items()
+                     if groups == observed), None)
+        if over is not None:
+            findings.append(LintFinding(
+                "foreign_axis", SEV_ERROR,
+                f"{inst.kind} runs over mesh axes {sorted(over)} but the "
+                f"strategy declares only {sorted(allowed)}: "
+                f"{inst.line[:160]}"))
+        else:
+            findings.append(LintFinding(
+                "foreign_axis", SEV_ERROR,
+                f"{inst.kind} replica groups match no mesh axis "
+                f"combination of {dict(mesh.shape)}: {inst.line[:160]}"))
+    return findings
+
+
+def lint_compiled_hlo(text: str, *, mesh=None, allowed_axes=None,
+                      full_param_shapes=(), allow_full_param_gather=False,
+                      donate_expected=False) -> list[LintFinding]:
+    """Run every check over one compiled-HLO module text."""
+    instances = collective_instances(text)
+    findings = []
+    findings += check_replication(
+        instances, set(map(tuple, full_param_shapes)),
+        allow_full_param_gather=allow_full_param_gather)
+    findings += check_donation(text, donate_expected=donate_expected)
+    findings += check_host_transfers(text)
+    findings += check_replica_axes(instances, mesh, allowed_axes)
+    return findings

@@ -1,0 +1,210 @@
+"""AST-level lint for the classic JAX training-script pitfalls.
+
+Static, import-free (pure ``ast`` — linting a script never executes or
+traces it), tuned so the current ``scripts/`` tree is clean at the
+``error`` level.  Three checks:
+
+  * ``hot-op-in-loop`` (warn) — a compute-heavy ``jnp.*`` / ``jax.nn.*``
+    call inside a Python ``for``/``while`` body in a function that isn't
+    jit-decorated: each iteration dispatches ops eagerly (op-by-op on
+    device, retrace-free but orders of magnitude off a fused step).
+    Data-movement calls (``asarray``/``array``/``zeros``…) are exempt —
+    host->device staging in the step loop is the normal pattern.
+  * ``collective-outside-shard-map`` (error) — the file calls axis
+    collectives (``lax.psum`` family / the ``ops.collectives`` wrappers)
+    but never references ``shard_map``/``smap``/``pmap``: the axis name
+    can't be bound, so the script either crashes at trace time or — the
+    nastier variant — someone "fixes" it by removing the axis and the
+    reduction silently disappears.
+  * ``step-jit-missing-donation`` (warn) — ``jax.jit(...)`` bound to a
+    ``*step*`` name without ``donate_argnums``: params + optimizer state
+    are double-buffered every step.
+
+Findings carry a severity; ``scripts/lint_sharding.py`` fails the run
+only on errors (``--strict`` promotes warnings).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+
+HOT_OPS = {
+    "dot", "matmul", "einsum", "tensordot", "exp", "log", "log2",
+    "softmax", "logsumexp", "mean", "sum", "prod", "var", "std",
+    "tanh", "sqrt", "square", "power", "cumsum", "sort", "argsort",
+    "take_along_axis", "relu", "gelu", "silu", "sigmoid",
+}
+DATA_MOVEMENT_OPS = {
+    "asarray", "array", "zeros", "ones", "full", "arange", "zeros_like",
+    "ones_like", "stack", "concatenate", "pad", "reshape", "split",
+}
+COLLECTIVE_FNS = {
+    "psum", "pmax", "pmin", "pmean", "psum_scatter", "all_gather",
+    "ppermute", "all_to_all", "axis_index", "all_reduce",
+    "reduce_scatter", "broadcast", "tree_all_reduce", "tree_all_gather",
+    "ppermute_ring", "barrier",
+}
+SHARD_WRAPPERS = {"shard_map", "smap", "pmap", "shmap", "xmap"}
+
+SEV_ERROR = "error"
+SEV_WARN = "warn"
+
+
+@dataclass
+class PitfallFinding:
+    path: str
+    line: int
+    check: str
+    severity: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "check": self.check,
+                "severity": self.severity, "message": self.message}
+
+
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute/name expression ('' if not one)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    chain = _attr_chain(call.func)
+    return chain.endswith("jit") and "jit" in chain.split(".")
+
+
+def _has_jit_decorator(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        if "jit" in _attr_chain(target):
+            return True
+        # functools.partial(jax.jit, ...) style
+        if isinstance(dec, ast.Call) and any(
+                "jit" in _attr_chain(a) for a in dec.args):
+            return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[PitfallFinding] = []
+        self._loop_depth = 0
+        self._jit_depth = 0
+        self.uses_shard_wrapper = False
+        self.collective_calls: list[tuple[int, str]] = []
+
+    # -- context tracking -------------------------------------------------
+    def _visit_function(self, node):
+        jitted = _has_jit_decorator(node)
+        self._jit_depth += jitted
+        # a nested function starts a fresh loop context: a closure built
+        # inside a loop body does not itself run per-iteration
+        saved, self._loop_depth = self._loop_depth, 0
+        self.generic_visit(node)
+        self._loop_depth = saved
+        self._jit_depth -= jitted
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _visit_function
+
+    def _visit_loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_While = _visit_loop
+
+    # -- checks -----------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        chain = _attr_chain(node.func)
+        leaf = chain.rsplit(".", 1)[-1]
+        root = chain.split(".", 1)[0]
+        if leaf in SHARD_WRAPPERS or root in SHARD_WRAPPERS:
+            self.uses_shard_wrapper = True
+        if (self._loop_depth and not self._jit_depth
+                and root in ("jnp", "jax")
+                and leaf in HOT_OPS and leaf not in DATA_MOVEMENT_OPS):
+            self.findings.append(PitfallFinding(
+                self.path, node.lineno, "hot-op-in-loop", SEV_WARN,
+                f"{chain}() inside a Python loop outside jit — each "
+                f"iteration dispatches eagerly; move the loop body into "
+                f"a jitted step (or lax.scan)"))
+        if (leaf in COLLECTIVE_FNS
+                and root in ("lax", "jax", "C", "collectives")):
+            self.collective_calls.append((node.lineno, chain))
+        if _is_jit_call(node):
+            self._check_donation(node)
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        if node.id in SHARD_WRAPPERS:
+            self.uses_shard_wrapper = True
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if node.attr in SHARD_WRAPPERS:
+            self.uses_shard_wrapper = True
+        self.generic_visit(node)
+
+    def _check_donation(self, node: ast.Call):
+        kw = {k.arg for k in node.keywords}
+        if kw & {"donate_argnums", "donate_argnames"}:
+            return
+        parent = getattr(node, "_assigned_name", None)
+        if parent and "step" in parent.lower():
+            self.findings.append(PitfallFinding(
+                self.path, node.lineno, "step-jit-missing-donation",
+                SEV_WARN,
+                f"jax.jit bound to {parent!r} without donate_argnums — "
+                f"params/opt-state are double-buffered every step"))
+
+
+def _annotate_assignments(tree: ast.AST) -> None:
+    """Tag each Call node with the simple name it's assigned to (for the
+    donation check's '*step*' heuristic)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    node.value._assigned_name = t.id
+
+
+def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [PitfallFinding(path, e.lineno or 0, "syntax", SEV_ERROR,
+                               f"not parseable: {e.msg}")]
+    _annotate_assignments(tree)
+    v = _Visitor(path)
+    v.visit(tree)
+    findings = list(v.findings)
+    if v.collective_calls and not v.uses_shard_wrapper:
+        line, chain = v.collective_calls[0]
+        findings.append(PitfallFinding(
+            path, line, "collective-outside-shard-map", SEV_ERROR,
+            f"{chain}() (+{len(v.collective_calls) - 1} more collective "
+            f"calls) but the file never enters shard_map/pmap — the axis "
+            f"name has nothing to bind to"))
+    return findings
+
+
+def lint_file(path) -> list[PitfallFinding]:
+    p = Path(path)
+    return lint_source(p.read_text(), str(p))
+
+
+def lint_tree(root) -> list[PitfallFinding]:
+    """Lint every ``*.py`` under ``root`` (non-recursive for a scripts/
+    dir, recursive otherwise is overkill — keep it flat like scripts/)."""
+    findings = []
+    for p in sorted(Path(root).glob("*.py")):
+        findings.extend(lint_file(p))
+    return findings

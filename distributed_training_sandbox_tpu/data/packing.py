@@ -235,13 +235,29 @@ def load_corpus_tokenizer(tokenizer_file):
                                    eos_token="<eos>", unk_token="<unk>")
 
 
-def corpus_holdout_split(input_ids, labels, *, frac: float = 0.05,
-                         min_windows: int = 1):
+# THE corpus train/holdout boundary parameters.  train_flagship.py and
+# eval_lm.py both split with these exact values (no per-script overrides)
+# so the evaluator can never score a window the trainer touched.
+CORPUS_HOLDOUT_FRAC = 0.05
+CORPUS_HOLDOUT_MIN_WINDOWS = 4
+
+
+def corpus_holdout_split(input_ids, labels, *,
+                         frac: float = CORPUS_HOLDOUT_FRAC,
+                         min_windows: int = CORPUS_HOLDOUT_MIN_WINDOWS):
     """ONE definition of the corpus train/holdout split: the TAIL
     ``frac`` of packed windows (≥ ``min_windows``) is held out.  Both
     the trainer (which must NOT touch it) and the evaluator (which
     scores exactly it) call this, so the two can never disagree about
     where the boundary sits."""
     n_hold = max(int(len(input_ids) * frac), min_windows)
+    if n_hold >= len(input_ids):
+        # a tiny corpus (or oversized frac/min_windows) would silently
+        # yield an empty train split and zero batches downstream — fail
+        # at the boundary where the misconfiguration is visible
+        raise ValueError(
+            f"corpus_holdout_split: holdout of {n_hold} windows would "
+            f"consume the whole corpus ({len(input_ids)} windows); need "
+            f"more data or smaller frac/min_windows")
     return ((input_ids[:-n_hold], labels[:-n_hold]),
             (input_ids[-n_hold:], labels[-n_hold:]))

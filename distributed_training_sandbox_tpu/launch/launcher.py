@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import json
 import os
+import shlex
 import shutil
 import subprocess
 import sys
@@ -289,12 +290,14 @@ def _run_multiprocess(config: LaunchConfig, cmd: list[str], env: dict,
     base_env = {k: v for k, v in env.items()
                 if k not in ("JAX_PLATFORMS", "JAX_NUM_PROCESSES")}
     # keep user XLA_FLAGS; strip only the host-device-count flag that
-    # would conflict with the per-worker cpu:K spec
+    # would conflict with the per-worker cpu:K spec.  shlex keeps flag
+    # values containing spaces (quoted --xla_dump_to paths) intact —
+    # str.split would shatter them into separate bogus tokens.
     if "XLA_FLAGS" in base_env:
-        kept = [f for f in base_env["XLA_FLAGS"].split()
+        kept = [f for f in shlex.split(base_env["XLA_FLAGS"])
                 if not f.startswith("--xla_force_host_platform_device_count")]
         if kept:
-            base_env["XLA_FLAGS"] = " ".join(kept)
+            base_env["XLA_FLAGS"] = shlex.join(kept)
         else:
             del base_env["XLA_FLAGS"]
     procs, logs = [], []

@@ -10,7 +10,9 @@ reorder them later, SURVEY.md §7.3) and optionally in the compiled HLO.
 
 from __future__ import annotations
 
+import math
 import re
+from dataclasses import dataclass, field
 from typing import Callable
 
 import jax
@@ -57,3 +59,113 @@ def count_collectives(fn_or_text, *args, optimized: bool = False,
         counts[name] = sum(len(re.findall(p, text)) for p in pats)
     counts["total"] = sum(counts.values())
     return counts
+
+
+# --------------------------------------------------------------- instances
+#
+# Per-instance parsing of *compiled* HLO: shape, payload bytes and replica
+# groups of every collective — what the analysis subsystem lints against
+# (``analysis.hlo_lint``).  count_collectives answers "how many"; this
+# answers "of what, and across whom".
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# "f32[16,16]{1,0}" / "bf16[8]" / "f32[]" — one array shape in HLO text.
+_SHAPE_RE = re.compile(r"([a-z]+\d*(?:e\d+m\d+(?:fn)?)?)\[([\d,]*)\]")
+
+# One collective instruction: "%name = <shape(s)> <opcode>(..." where the
+# opcode is a sync collective or its async "-start" half ("-done" never
+# matches: the char after the stem is "-", not "(" — same trick as
+# _PATTERNS).
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?P<shape>\([^)]*\)|\S+)\s+"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?P<start>-start)?\(")
+
+_GROUPS_LITERAL_RE = re.compile(r"replica_groups=\{(\{[\d,{}\s]*\})\}")
+# iota form: replica_groups=[4,2]<=[2,4]T(1,0) (transpose optional)
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+
+
+def parse_shape(s: str) -> tuple[str, tuple[int, ...]] | None:
+    """One HLO array shape string -> (dtype, dims), or None if not one."""
+    m = _SHAPE_RE.match(s)
+    if not m:
+        return None
+    dt, dims = m.group(1), m.group(2)
+    return dt, tuple(int(d) for d in dims.split(",")) if dims else ()
+
+
+def parse_replica_groups(line: str) -> tuple[tuple[int, ...], ...] | None:
+    """The replica groups of one HLO instruction line, as a tuple of
+    device-id groups.  Handles both the literal ``{{0,1},{2,3}}`` form and
+    the iota ``[G,S]<=[dims]T(perm)`` form (reshape-transpose of
+    ``arange(n)``).  None when the line carries no parseable groups."""
+    m = _GROUPS_LITERAL_RE.search(line)
+    if m:
+        groups = []
+        for g in re.findall(r"\{([\d,\s]*)\}", m.group(1)):
+            ids = tuple(int(x) for x in g.replace(" ", "").split(",") if x)
+            if ids:
+                groups.append(ids)
+        return tuple(groups) if groups else None
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        seq = list(range(math.prod(dims)))
+        if m.group(4):  # reshape to dims, transpose, then regroup
+            perm = [int(p) for p in m.group(4).split(",")]
+            import numpy as np
+            arr = np.arange(math.prod(dims)).reshape(dims).transpose(perm)
+            seq = list(arr.reshape(-1))
+        return tuple(
+            tuple(int(i) for i in seq[g * group_size:(g + 1) * group_size])
+            for g in range(n_groups))
+    return None
+
+
+@dataclass(frozen=True)
+class CollectiveInstance:
+    """One collective instruction parsed out of compiled HLO text."""
+    kind: str                                   # "all_reduce", ... (as in
+    #                                             count_collectives keys)
+    shapes: tuple[tuple[int, ...], ...] = ()    # output array dims
+    dtypes: tuple[str, ...] = ()
+    bytes: int = 0                              # summed output payload
+    replica_groups: tuple[tuple[int, ...], ...] | None = None
+    is_async_start: bool = False
+    line: str = field(default="", compare=False)
+
+
+def collective_instances(text: str) -> list[CollectiveInstance]:
+    """Every collective in compiled HLO text, with shapes + replica groups.
+
+    Async pairs are counted once (the ``-start`` op carries the info; the
+    ``-done`` op never matches).  Works on post-XLA ``compile().as_text()``
+    output; StableHLO callers should keep using ``count_collectives``."""
+    out = []
+    for raw in text.splitlines():
+        m = _INSTR_RE.match(raw)
+        if not m:
+            continue
+        shapes, dtypes, nbytes = [], [], 0
+        for sm in _SHAPE_RE.finditer(m.group("shape")):
+            dt = sm.group(1)
+            dims = tuple(int(d) for d in sm.group(2).split(",")) \
+                if sm.group(2) else ()
+            shapes.append(dims)
+            dtypes.append(dt)
+            nbytes += math.prod(dims) * _DTYPE_BYTES.get(dt, 4)
+        out.append(CollectiveInstance(
+            kind=m.group("op").replace("-", "_"),
+            shapes=tuple(shapes), dtypes=tuple(dtypes), bytes=nbytes,
+            replica_groups=parse_replica_groups(raw),
+            is_async_start=bool(m.group("start")), line=raw.strip()))
+    return out

@@ -63,17 +63,21 @@ class RunManifest:
     git_sha: str | None = None
     started_utc: str = ""
     collective_counts: dict | None = None
+    contract: dict | None = None
     extra: dict = field(default_factory=dict)
 
     @classmethod
     def capture(cls, strategy: str, *, run_id: str = "",
                 config: Any = None, mesh=None, model: str | None = None,
                 collective_counts: dict | None = None,
+                contract: dict | None = None,
                 extra: dict | None = None) -> "RunManifest":
         """Snapshot the environment at step 0.  ``mesh`` is a
         ``jax.sharding.Mesh`` (or None for meshless scripts);
         ``collective_counts`` is the ``count_collectives`` dict the
-        scripts already compute for their startup print."""
+        scripts already compute for their startup print; ``contract``
+        is the ``analysis.ContractVerdict.to_dict()`` of checking those
+        counts against the strategy's choreography contract."""
         import jax
         dev = jax.devices()[0]
         jaxlib_version = None
@@ -101,6 +105,7 @@ class RunManifest:
             started_utc=datetime.datetime.now(
                 datetime.timezone.utc).isoformat(timespec="seconds"),
             collective_counts=collective_counts,
+            contract=contract,
             extra=dict(extra or {}),
         )
 

@@ -116,6 +116,9 @@ def run_row(rec: dict) -> dict:
         "status": summ.get("status", "?"),
         "num_steps": rec.get("num_steps", 0),
         "collective_counts": man.get("collective_counts"),
+        # choreography-contract verdict (analysis.evaluate_contract),
+        # recorded by the strategy scripts since manifests grew the field
+        "contract_ok": (man.get("contract") or {}).get("ok"),
     }
     for k in ("step_time_ms", "tokens_per_second", "tflops_per_device",
               "avg_loss", "final_loss", "peak_memory_gb"):
@@ -200,6 +203,11 @@ def render_table(rows: list[dict]) -> str:
                                          r.get("run_id") or "")):
         cc = r.get("collective_counts") or {}
         cc_cell = str(cc.get("total")) if cc else "—"
+        # annotate with the contract verdict when one was recorded
+        if r.get("contract_ok") is True:
+            cc_cell += " ✓"
+        elif r.get("contract_ok") is False:
+            cc_cell += " ✗"
         comm = r.get("comm_fraction")
         out.append(
             f"| {r.get('run_id', '—')} | {r.get('strategy', '—')} "

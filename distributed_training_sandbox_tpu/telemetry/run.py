@@ -42,6 +42,7 @@ class TelemetryRun:
     def __init__(self, strategy: str, *, config=None, mesh=None,
                  model: str | None = None,
                  collective_counts: dict | None = None,
+                 contract: dict | None = None,
                  extra: dict | None = None,
                  results_dir: str | None = None,
                  run_name: str | None = None,
@@ -52,6 +53,7 @@ class TelemetryRun:
         self.mesh = mesh
         self.model = model
         self.collective_counts = collective_counts
+        self.contract = contract
         self.extra = extra
         self.profiler = profiler
         if results_dir is None:
@@ -97,6 +99,7 @@ class TelemetryRun:
                 self.strategy, run_id=self.run_id, config=self.config,
                 mesh=self.mesh, model=self.model,
                 collective_counts=self.collective_counts,
+                contract=self.contract,
                 extra=self.extra)
             self.writer = MetricsWriter(self.run_dir)
             self.writer.write_manifest(self.manifest)

@@ -100,6 +100,10 @@ def run(mode: str, argv=None):
     expect = ("ppermutes from the KV ring + dp gathers/reduce-scatters"
               if mode == "sp" else "2 psums/layer + grad syncs")
     print(f"[{name}] per-step collectives (HLO): {counts} ({expect})")
+    from distributed_training_sandbox_tpu.analysis import evaluate_contract
+    verdict = evaluate_contract(mode, counts, params=shards, mesh=mesh,
+                                n_layers=mcfg.num_hidden_layers)
+    print(f"[{name}] contract[{mode}]: {verdict.summary()}")
 
     flops_tok = get_model_flops_per_token(mcfg, cfg.sequence_length)
     tracker = PerformanceTracker(
@@ -115,6 +119,7 @@ def run(mode: str, argv=None):
                              epochs=cfg.num_epochs * cfg.num_steps)
     with TelemetryRun(name, config=cfg, mesh=mesh, model=args.model,
                       collective_counts=counts, profiler=prof,
+                      contract=verdict.to_dict(),
                       extra={mode: second}) as telem:
         for i in range(cfg.num_steps):
             with annotate("data_movement"):

@@ -103,6 +103,19 @@ def main(schedule: str, argv=None):
     print(f"[{schedule}] model={args.model} stages={args.n_stages} "
           f"micro={args.n_micro} devices={devs}")
 
+    # choreography contract: stage programs must carry ZERO mesh
+    # collectives — inter-stage comm is host-mediated device transfer.
+    # gpipe vs 1f1b share the contract; interleaved rides on 1f1b's.
+    from distributed_training_sandbox_tpu.analysis import evaluate_contract
+    from distributed_training_sandbox_tpu.ops import count_collectives
+    x0, _ = make_batch(0)
+    stage_counts = count_collectives(
+        stages[0].fwd.lower(stages[0].params, x0).as_text())
+    cname = schedule if schedule in ("gpipe", "1f1b") else "1f1b"
+    verdict = evaluate_contract(cname, stage_counts,
+                                params=stages[0].params)
+    print(f"[{schedule}] contract[{cname}]: {verdict.summary()}")
+
     prof = Profiler(trace_dir=cfg.trace_dir,
                     schedule=ProfileSchedule(skip_first=2, wait=1, warmup=1,
                                              active=4)) if cfg.profile else None
@@ -125,6 +138,7 @@ def main(schedule: str, argv=None):
         prof.stop()
 
     out = result.as_dict()   # incl. max_stored_activations + memory plan
+    out["contract"] = verdict.to_dict()
     print(f"[{schedule}] {json.dumps(out)}")
     if args.results_file:
         Path(args.results_file).write_text(json.dumps(out, indent=2))

@@ -113,9 +113,14 @@ def run_zero_ab(stage: int, argv=None):
         mse_loss, lambda g, s, p: optim.adam_update(g, s, p), mesh, "dp",
         donate=False)
     base_counts = count_collectives(base_step, params, base_opt, batch)
+    from distributed_training_sandbox_tpu.analysis import evaluate_contract
+    base_verdict = evaluate_contract("ddp", base_counts, params=params,
+                                     mesh=mesh)
+    print(f"[{name}] contract[ddp/baseline]: {base_verdict.summary()}")
     # one TelemetryRun per leg: the crash-safe owner of that leg's profiler
     with TelemetryRun(f"{name}-baseline", config=cfg, mesh=mesh,
                       model="toy-mlp", collective_counts=base_counts,
+                      contract=base_verdict.to_dict(),
                       profiler=make_prof("baseline"),
                       extra={"leg": "baseline", "stage": stage,
                              "scale": args.scale}) as telem_a:
@@ -137,8 +142,15 @@ def run_zero_ab(stage: int, argv=None):
         step = make_zero3_train_step(loss_fn, mesh, "dp", donate=False)
         state0 = (shard_params_zero3(params, mesh, "dp"), opt)
     shard_counts = count_collectives(step, *state0, batch)
+    # zero3's rebuild knob is fixed (all_gather materialize); 1/2 honor
+    # --rebuild, which the contract formula needs to pick the right counts
+    shard_verdict = evaluate_contract(
+        name, shard_counts, params=params, mesh=mesh,
+        **({"rebuild": args.rebuild} if stage in (1, 2) else {}))
+    print(f"[{name}] contract[{name}]: {shard_verdict.summary()}")
     with TelemetryRun(name, config=cfg, mesh=mesh, model="toy-mlp",
                       collective_counts=shard_counts,
+                      contract=shard_verdict.to_dict(),
                       profiler=make_prof("sharded"),
                       extra={"leg": "sharded", "stage": stage,
                              "scale": args.scale,

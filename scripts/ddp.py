@@ -104,6 +104,9 @@ def main(argv=None):
     n_params = len(jax.tree.leaves(params))
     print(f"[ddp] per-step collectives (HLO): {counts} "
           f"(expect {n_params} grad all_reduces + loss mean + barrier)")
+    from distributed_training_sandbox_tpu.analysis import evaluate_contract
+    verdict = evaluate_contract("ddp", counts, params=params, mesh=mesh)
+    print(f"[ddp] contract[ddp]: {verdict.summary()}")
 
     tracker = PerformanceTracker(warmup_steps=min(5, cfg.num_steps - 1) if
                                  cfg.num_steps > 1 else 0)
@@ -114,7 +117,8 @@ def main(argv=None):
     # TelemetryRun owns the profiler: a crash mid-loop still flushes the
     # in-flight trace and writes a status="crashed" summary
     with TelemetryRun("ddp", config=cfg, mesh=mesh, model="mlp",
-                      collective_counts=counts, profiler=prof) as telem:
+                      collective_counts=counts,
+                      contract=verdict.to_dict(), profiler=prof) as telem:
         for i in range(cfg.num_steps):
             with annotate("data_movement"):
                 key, bk = jax.random.split(key)
@@ -204,6 +208,9 @@ def classification_main(args, rest):
     n_leaves = len(jax.tree.leaves(params))
     print(f"[ddp] per-step collectives (HLO): {counts} "
           f"(expect {n_leaves} grad all_reduces + loss mean + barrier)")
+    from distributed_training_sandbox_tpu.analysis import evaluate_contract
+    verdict = evaluate_contract("ddp", counts, params=params, mesh=mesh)
+    print(f"[ddp] contract[ddp]: {verdict.summary()}")
 
     tracker = PerformanceTracker(warmup_steps=min(3, cfg.num_steps - 1) if
                                  cfg.num_steps > 1 else 0)
@@ -213,7 +220,8 @@ def classification_main(args, rest):
     metrics = None
     batch = first
     with TelemetryRun("ddp", config=cfg, mesh=mesh, model=args.model,
-                      collective_counts=counts, profiler=prof) as telem:
+                      collective_counts=counts,
+                      contract=verdict.to_dict(), profiler=prof) as telem:
         for i in range(cfg.num_steps):
             with annotate("data_movement"):
                 jbatch = {k: jnp.asarray(v) for k, v in batch.items()}

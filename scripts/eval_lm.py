@@ -7,9 +7,10 @@ model; a framework a user switches TO needs the other half, like the
 decode face in ``models/generate.py``).
 
   * ``--data corpus`` evaluates on the committed real-text corpus
-    (``data/corpus/``) with a held-out TAIL split (the last
-    ``--holdout-frac`` of windows — the training scripts iterate from
-    the front, so the tail is the natural untouched slice);
+    (``data/corpus/``) with a held-out TAIL split whose boundary is
+    pinned to the trainer's (``data.packing.CORPUS_HOLDOUT_FRAC`` /
+    ``CORPUS_HOLDOUT_MIN_WINDOWS`` — shared constants, not CLI knobs,
+    so eval can never score windows train_flagship.py trained on);
   * ``--ckpt-dir`` restores ``{"params": ...}`` (and ignores any opt
     state) from the newest step of an Orbax checkpoint manager run
     written by ``utils.checkpoint.save_state``;
@@ -40,8 +41,6 @@ def main(argv=None):
                    default="corpus")
     p.add_argument("--sequence-length", type=int, default=2048)
     p.add_argument("--batch-size", type=int, default=4)
-    p.add_argument("--holdout-frac", type=float, default=0.05,
-                   help="tail fraction of the packed windows to score")
     p.add_argument("--ckpt-dir", default=None,
                    help="Orbax checkpoint dir (newest step restored); "
                         "default scores the fresh init — the baseline "
@@ -81,11 +80,15 @@ def main(argv=None):
         ii, ll = make_packed_dataset(seq, mcfg.vocab_size,
                                      num_tokens=64 * bs * (seq + 1),
                                      source="synthetic")
+    # split with the shared defaults — the SAME boundary the trainer
+    # reserved, by construction (no per-script frac/min_windows)
     from distributed_training_sandbox_tpu.data.packing import (
         corpus_holdout_split)
-    _, (ii, ll) = corpus_holdout_split(ii, ll, frac=args.holdout_frac,
-                                       min_windows=bs)
-    print(f"[eval] holdout: {len(ii)} windows × seq {seq}")
+    _, (ii, ll) = corpus_holdout_split(ii, ll)
+    # a small holdout may undershoot the requested batch size; clamp so
+    # drop_last batching still yields at least one eval batch
+    bs = min(bs, len(ii))
+    print(f"[eval] holdout: {len(ii)} windows × seq {seq} (batch {bs})")
 
     params = T.init_params(set_seed(42), mcfg)
     restored_step = None

@@ -1,0 +1,160 @@
+"""Static sharding & collective contract analyzer — the CI face of
+``distributed_training_sandbox_tpu.analysis``.
+
+For every strategy (or a ``--strategies`` subset) this:
+
+  1. builds the tiny canonical instance of its train step on a simulated
+     CPU mesh (``analysis.fixtures``) and lowers it;
+  2. checks the StableHLO collective site counts against the strategy's
+     :class:`CollectiveContract` (``analysis.contracts``);
+  3. lints the *compiled* HLO: accidental full-param replication,
+     missing donation aliasing, host transfers, collectives outside the
+     contract's declared mesh axes (``analysis.hlo_lint``);
+  4. executes 3 steps and fails on any retrace after the first
+     (``analysis.recompile``; skip with ``--skip-recompile``);
+
+then AST-lints ``scripts/`` for eager-loop / collective-scope /
+donation pitfalls (``analysis.pitfalls``).
+
+Exit status is nonzero on any contract violation, error-severity lint
+finding, or detected recompile — wire it into CI next to the test
+suite.  ``--json PATH`` (or ``-`` for stdout) writes the full report.
+
+  python scripts/lint_sharding.py --cpu-devices 8
+  python scripts/lint_sharding.py --strategies ddp,zero1 --json -
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+# Prepend the checkout root so the source tree always wins over any
+# installed copy of the package (`pip install -e .` makes this a no-op).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def analyze_strategy(name: str, *, skip_recompile: bool = False,
+                     skip_compiled: bool = False, n_steps: int = 4) -> dict:
+    """Contract + HLO lint + recompile report for one strategy.  Returns
+    the per-strategy report dict (key ``ok`` rolls the three up)."""
+    from distributed_training_sandbox_tpu.analysis import (
+        check_counts, lint_compiled_hlo)
+    from distributed_training_sandbox_tpu.analysis.fixtures import (
+        build_strategy)
+    from distributed_training_sandbox_tpu.analysis.recompile import (
+        watch_recompiles)
+    from distributed_training_sandbox_tpu.ops.hlo import count_collectives
+    import jax
+
+    build = build_strategy(name)
+    step = build.step if hasattr(build.step, "lower") \
+        else jax.jit(build.step)
+    lowered = step.lower(*build.args)
+
+    counts = count_collectives(lowered.as_text())
+    verdict = check_counts(build.contract, counts, build.ctx)
+    report = {"contract": verdict.to_dict(), "lint": [], "recompile": None}
+    print(f"[lint] {name:6s} contract: {verdict.summary()}")
+
+    if not skip_compiled:
+        compiled = lowered.compile().as_text()
+        findings = lint_compiled_hlo(
+            compiled, mesh=build.mesh,
+            allowed_axes=build.contract.axes or None,
+            full_param_shapes=build.full_param_shapes,
+            allow_full_param_gather=build.contract.allows_full_param_gather,
+            donate_expected=build.donate)
+        report["lint"] = [f.to_dict() for f in findings]
+        for f in findings:
+            print(f"[lint] {name:6s} {f.severity}: [{f.check}] {f.message}")
+        if not findings:
+            print(f"[lint] {name:6s} hlo lint: clean")
+
+    if not skip_recompile:
+        rec = watch_recompiles(build.step, build.args, n_steps=n_steps,
+                               advance=build.advance)
+        report["recompile"] = rec.to_dict()
+        print(f"[lint] {name:6s} recompile: {rec.summary()}")
+
+    report["ok"] = (
+        verdict.ok
+        and not any(f["severity"] == "error" for f in report["lint"])
+        and (report["recompile"] is None or report["recompile"]["ok"]))
+    return report
+
+
+def main(argv=None) -> int:
+    from distributed_training_sandbox_tpu.analysis.fixtures import STRATEGIES
+
+    p = argparse.ArgumentParser(
+        description="static sharding/collective contract analyzer")
+    p.add_argument("--cpu-devices", type=int, default=8,
+                   help="simulated CPU mesh size (0 = use live backend)")
+    p.add_argument("--strategies", type=str, default=",".join(STRATEGIES),
+                   help="comma-separated subset (default: all)")
+    p.add_argument("--skip-recompile", action="store_true",
+                   help="skip the 3-step retrace check (no execution)")
+    p.add_argument("--skip-compiled", action="store_true",
+                   help="skip compiled-HLO lint passes (faster; contract "
+                        "counts only)")
+    p.add_argument("--skip-scripts", action="store_true",
+                   help="skip the AST pitfall lint over --scripts-dir")
+    p.add_argument("--scripts-dir", type=str,
+                   default=str(Path(__file__).resolve().parent),
+                   help="directory whose *.py get the AST pitfall lint")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings also fail the run")
+    p.add_argument("--json", dest="json_out", type=str, default=None,
+                   help="write the JSON report here ('-' = stdout)")
+    args = p.parse_args(argv)
+
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    report: dict = {"strategies": {}, "pitfalls": [], "ok": True}
+
+    for name in [s for s in args.strategies.split(",") if s]:
+        sub = analyze_strategy(name, skip_recompile=args.skip_recompile,
+                               skip_compiled=args.skip_compiled)
+        report["strategies"][name] = sub
+        report["ok"] &= sub["ok"]
+
+    if not args.skip_scripts:
+        from distributed_training_sandbox_tpu.analysis import lint_tree
+        findings = lint_tree(args.scripts_dir)
+        report["pitfalls"] = [f.to_dict() for f in findings]
+        errors = [f for f in findings if f.severity == "error"]
+        for f in findings:
+            print(f"[lint] pitfall {f.severity}: {f.path}:{f.line} "
+                  f"[{f.check}] {f.message}")
+        if errors or (args.strict and findings):
+            report["ok"] = False
+        print(f"[lint] pitfalls: {len(errors)} error(s), "
+              f"{len(findings) - len(errors)} warning(s) over "
+              f"{args.scripts_dir}")
+
+    if args.strict:
+        for sub in report["strategies"].values():
+            if any(f["severity"] == "warn" for f in sub["lint"]):
+                sub["ok"] = False
+                report["ok"] = False
+
+    if args.json_out:
+        payload = json.dumps(report, indent=2)
+        if args.json_out == "-":
+            print(payload)
+        else:
+            Path(args.json_out).write_text(payload + "\n")
+            print(f"[lint] report -> {args.json_out}")
+
+    print(f"[lint] {'PASS' if report['ok'] else 'FAIL'} "
+          f"({len(report['strategies'])} strategies)")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -77,14 +77,24 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
             tokenizer_file=root / "data" / "corpus" / "tokenizer.json")
         # reserve the tail as scripts/eval_lm.py's held-out split —
         # multi-epoch runs would otherwise train on it; ONE shared
-        # definition of the boundary (data.packing.corpus_holdout_split)
+        # definition of the boundary AND its parameters
+        # (data.packing.corpus_holdout_split + CORPUS_HOLDOUT_*), so
+        # eval scores exactly the windows this run never saw
         from distributed_training_sandbox_tpu.data.packing import (
             corpus_holdout_split)
-        (ii, ll), (hi, _) = corpus_holdout_split(ii, ll, min_windows=bs)
-        epochs = -(-num_steps * bs // max(len(ii), 1))
+        (ii, ll), (hi, _) = corpus_holdout_split(ii, ll)
+        # packed_batches(drop_last=True) yields len(ii)//bs batches per
+        # epoch — epochs must come from USABLE windows or runs with
+        # len(ii) % bs != 0 end short of --num-steps
+        usable = len(ii) // bs
+        if not usable:
+            raise SystemExit(
+                f"[flagship] corpus too small: {len(ii)} train windows "
+                f"< batch size {bs}")
+        epochs = -(-num_steps // usable)
         print(f"[flagship] corpus: {len(ii)} windows x seq {seq} "
-              f"(+{len(hi)} held out; {epochs} epoch(s) for "
-              f"{num_steps} steps)")
+              f"(+{len(hi)} held out; {epochs} epoch(s) x {usable} "
+              f"batches for {num_steps} steps)")
     else:
         # fresh windows for every step (engine="native": the C++ sampler,
         # ~10x faster stream builds at this size)

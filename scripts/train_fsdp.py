@@ -119,6 +119,15 @@ def main(argv=None):
     probe = (jnp.zeros((cfg.batch_size, cfg.sequence_length), jnp.int32),) * 2
     counts = count_collectives(step, shards, opt_state, probe)
     print(f"[fsdp] per-step collectives (HLO): {counts}")
+    # the auto variant's choreography is XLA's choice, not ours to contract
+    verdict = None
+    if args.variant == "explicit":
+        from distributed_training_sandbox_tpu.analysis import (
+            evaluate_contract)
+        verdict = evaluate_contract("fsdp", counts, params=shards,
+                                    mesh=mesh,
+                                    n_layers=mcfg.num_hidden_layers)
+        print(f"[fsdp] contract[fsdp]: {verdict.summary()}")
 
     metrics = None
     tokens_per_step = cfg.batch_size * cfg.sequence_length
@@ -126,6 +135,7 @@ def main(argv=None):
                              epochs=cfg.num_epochs * cfg.num_steps)
     with TelemetryRun("fsdp", config=cfg, mesh=mesh, model=args.model,
                       collective_counts=counts, profiler=prof,
+                      contract=verdict.to_dict() if verdict else None,
                       extra={"variant": args.variant,
                              "reshard_after_forward": args.reshard}) as telem:
         for i in range(cfg.num_steps):

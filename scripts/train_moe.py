@@ -109,6 +109,11 @@ def main(argv=None):
     counts = count_collectives(step, shards, opt_state, probe)
     print(f"[train_moe] per-step collectives (HLO): {counts} "
           f"(a2a dispatch/return in the scanned layer body + grad syncs)")
+    from distributed_training_sandbox_tpu.analysis import evaluate_contract
+    verdict = evaluate_contract("moe", counts, params=shards, mesh=mesh,
+                                n_layers=mcfg.num_hidden_layers,
+                                top_k=args.top_k)
+    print(f"[train_moe] contract[moe]: {verdict.summary()}")
 
     tracker = PerformanceTracker(
         warmup_steps=min(3, max(cfg.num_steps - 1, 0)),
@@ -124,6 +129,7 @@ def main(argv=None):
                              epochs=cfg.num_epochs * cfg.num_steps)
     with TelemetryRun("moe", config=cfg, mesh=mesh, model=args.model,
                       collective_counts=counts, profiler=prof,
+                      contract=verdict.to_dict(),
                       extra={"experts": args.experts, "ep": args.ep,
                              "top_k": args.top_k}) as telem:
         for i in range(cfg.num_steps):

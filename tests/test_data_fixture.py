@@ -156,8 +156,7 @@ def test_eval_lm_lifecycle_restores_and_scores(tmp_path):
     from distributed_training_sandbox_tpu.utils import checkpoint as C
 
     init = eval_main(["--model", "corpus-70m", "--data", "corpus",
-                      "--sequence-length", "256", "--batch-size", "4",
-                      "--holdout-frac", "0.01"])
+                      "--sequence-length", "256", "--batch-size", "4"])
     assert init["restored_step"] is None
     assert init["perplexity"] > 1000          # untrained ≈ uniform
 
@@ -167,7 +166,6 @@ def test_eval_lm_lifecycle_restores_and_scores(tmp_path):
     mgr.wait_until_finished()
     restored = eval_main(["--model", "corpus-70m", "--data", "corpus",
                           "--sequence-length", "256", "--batch-size", "4",
-                          "--holdout-frac", "0.01",
                           "--ckpt-dir", str(tmp_path / "ck")])
     assert restored["restored_step"] == 5
     assert restored["eval_loss"] != init["eval_loss"]
@@ -191,3 +189,16 @@ def test_corpus_holdout_split_is_disjoint_and_shared():
     (_, _), (h2, _) = corpus_holdout_split(ii[:10], ll[:10], frac=0.05,
                                            min_windows=4)
     assert len(h2) == 4
+    # a holdout that would consume the whole corpus fails loudly instead
+    # of returning an empty train split (zero batches downstream)
+    with pytest.raises(ValueError, match="whole corpus"):
+        corpus_holdout_split(ii[:4], ll[:4], frac=0.05, min_windows=4)
+    with pytest.raises(ValueError, match="whole corpus"):
+        corpus_holdout_split(ii[:2], ll[:2], frac=0.05, min_windows=4)
+    # trainer and evaluator pin the SAME shared defaults — drift between
+    # the two scripts would re-open the train-on-holdout hole
+    from distributed_training_sandbox_tpu.data.packing import (
+        CORPUS_HOLDOUT_FRAC, CORPUS_HOLDOUT_MIN_WINDOWS)
+    (t3, _), (h3, _) = corpus_holdout_split(ii, ll)
+    assert len(h3) == max(int(len(ii) * CORPUS_HOLDOUT_FRAC),
+                          CORPUS_HOLDOUT_MIN_WINDOWS)

@@ -1,0 +1,94 @@
+"""ops.hlo parsing: async-pair counting, per-instance shapes/bytes and
+replica-group decoding — the substrate the analysis lints stand on.
+All on hand-written HLO snippets; nothing lowers or compiles here."""
+
+import numpy as np
+
+from distributed_training_sandbox_tpu.ops.hlo import (
+    collective_instances, count_collectives, parse_replica_groups,
+    parse_shape)
+
+# a compiled-HLO-shaped snippet with one sync collective, one async pair
+# and one -done that must never count
+ASYNC_HLO = """\
+HloModule jit_step, is_scheduled=true
+ENTRY %main {
+  %ar0 = f32[16,16]{1,0} all-reduce(f32[16,16]{1,0} %p0), channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true, to_apply=%add
+  %ars = (f32[8,4]{1,0}, f32[8,4]{1,0}) all-reduce-start(f32[8,4]{1,0} %p1), channel_id=2, replica_groups={{0,1},{2,3},{4,5},{6,7}}, to_apply=%add
+  %ard = f32[8,4]{1,0} all-reduce-done((f32[8,4]{1,0}, f32[8,4]{1,0}) %ars)
+  %ags = (f32[4,2]{1,0}, f32[32,2]{1,0}) all-gather-start(f32[4,2]{1,0} %p2), channel_id=3, replica_groups=[2,4]<=[8], dimensions={0}
+  %agd = f32[32,2]{1,0} all-gather-done((f32[4,2]{1,0}, f32[32,2]{1,0}) %ags)
+}
+"""
+
+
+def test_async_pairs_count_once():
+    """all-reduce-start counts once; -done never counts (the comment in
+    ops.hlo._PATTERNS, now pinned by a test)."""
+    counts = count_collectives(ASYNC_HLO)
+    assert counts["all_reduce"] == 2      # sync + start, NOT done
+    assert counts["all_gather"] == 1      # start only
+    assert counts["total"] == 3
+
+
+def test_collective_instances_shapes_bytes_groups():
+    insts = collective_instances(ASYNC_HLO)
+    assert [i.kind for i in insts] == ["all_reduce", "all_reduce",
+                                       "all_gather"]
+    sync = insts[0]
+    assert sync.shapes == ((16, 16),) and sync.dtypes == ("f32",)
+    assert sync.bytes == 16 * 16 * 4
+    assert sync.replica_groups == ((0, 1, 2, 3, 4, 5, 6, 7),)
+    assert not sync.is_async_start
+
+    start = insts[1]
+    assert start.is_async_start
+    assert start.replica_groups == ((0, 1), (2, 3), (4, 5), (6, 7))
+    assert start.shapes == ((8, 4), (8, 4))  # tuple-typed async output
+
+    ag = insts[2]
+    assert ag.is_async_start
+    # iota form [2,4]<=[8]: arange(8) regrouped into 2 rows of 4
+    assert ag.replica_groups == ((0, 1, 2, 3), (4, 5, 6, 7))
+
+
+def test_parse_replica_groups_iota_transpose():
+    """[4,2]<=[2,4]T(1,0): reshape arange(8) to (2,4), transpose, regroup
+    — the form XLA emits for a dp-group collective on a (dp=2, tp=4)
+    mesh (verified against a live lowering in test_contracts)."""
+    line = "x = f32[1] all-gather(f32[1] %p), replica_groups=[4,2]<=[2,4]T(1,0)"
+    groups = parse_replica_groups(line)
+    expect = np.arange(8).reshape(2, 4).T.reshape(4, 2)
+    assert groups == tuple(tuple(int(i) for i in row) for row in expect)
+
+
+def test_parse_replica_groups_absent():
+    assert parse_replica_groups("y = f32[2] add(f32[2] %a, f32[2] %b)") \
+        is None
+
+
+def test_parse_shape():
+    assert parse_shape("f32[16,8]{1,0}") == ("f32", (16, 8))
+    assert parse_shape("bf16[4]") == ("bf16", (4,))
+    assert parse_shape("pred[]") == ("pred", ())
+    assert parse_shape("%not-a-shape") is None
+
+
+def test_instances_on_live_lowering(mesh8):
+    """collective_instances agrees with count_collectives on a real
+    compiled module, and carries full-world groups for a dp psum."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from distributed_training_sandbox_tpu.ops import collectives as C
+
+    f = jax.jit(C.smap(lambda x: C.all_reduce(x, "dp"), mesh8,
+                       P("dp"), P("dp")))
+    text = f.lower(jnp.ones((8, 4))).compile().as_text()
+    insts = collective_instances(text)
+    kinds = [i.kind for i in insts]
+    assert kinds.count("all_reduce") == \
+        count_collectives(text)["all_reduce"] == 1
+    (ar,) = [i for i in insts if i.kind == "all_reduce"]
+    assert ar.replica_groups == ((0, 1, 2, 3, 4, 5, 6, 7),)
+    assert ar.shapes == ((1, 4),)  # per-device shard of the (8,4) input

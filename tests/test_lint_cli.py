@@ -1,0 +1,53 @@
+"""scripts/lint_sharding.py end-to-end (in-process): passes on a clean
+strategy subset, reports JSON, and exits nonzero on seeded violations."""
+
+import json
+
+import pytest
+
+pytestmark = pytest.mark.contracts
+
+
+def _main(argv):
+    from scripts.lint_sharding import main
+    return main(argv)
+
+
+def test_cli_passes_on_ddp_and_writes_json(tmp_path):
+    out = tmp_path / "report.json"
+    rc = _main(["--cpu-devices", "0", "--strategies", "ddp",
+                "--skip-recompile", "--skip-scripts",
+                "--json", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["ok"] is True
+    ddp = report["strategies"]["ddp"]
+    assert ddp["contract"]["ok"] is True
+    assert ddp["contract"]["observed"]["all_reduce"] == 14
+    assert ddp["lint"] == []
+    assert ddp["recompile"] is None           # skipped
+
+
+def test_cli_fails_on_seeded_pitfall_dir(tmp_path):
+    bad = tmp_path / "scripts"
+    bad.mkdir()
+    (bad / "rogue.py").write_text(
+        "from jax import lax\n"
+        "def f(x):\n"
+        "    return lax.psum(x, 'dp')\n")
+    rc = _main(["--cpu-devices", "0", "--strategies", "",
+                "--scripts-dir", str(bad)])
+    assert rc == 1
+
+
+def test_cli_recompile_leg_on_pipeline(tmp_path):
+    """gpipe's stage program: cheapest full leg (lower + compile + 3
+    executed steps) — exercises the recompile path end to end."""
+    out = tmp_path / "r.json"
+    rc = _main(["--cpu-devices", "0", "--strategies", "gpipe",
+                "--skip-scripts", "--json", str(out)])
+    assert rc == 0
+    rep = json.loads(out.read_text())["strategies"]["gpipe"]
+    assert rep["recompile"]["ok"] is True
+    assert rep["contract"]["observed"] == {
+        k: 0 for k in rep["contract"]["observed"]}

@@ -1,0 +1,126 @@
+"""AST pitfall lint: each check fires on a seeded violation, stays quiet
+on idiomatic code, and the shipped scripts/ tree is clean at the error
+level (the property the CI lint gate relies on)."""
+
+from pathlib import Path
+
+from distributed_training_sandbox_tpu.analysis.pitfalls import (
+    SEV_ERROR, lint_file, lint_source, lint_tree)
+
+SCRIPTS_DIR = Path(__file__).resolve().parent.parent / "scripts"
+
+
+def _checks(findings):
+    return {f.check for f in findings}
+
+
+def test_hot_op_in_eager_loop_fires():
+    src = """
+import jax.numpy as jnp
+def train(params, batches):
+    total = 0.0
+    for b in batches:
+        total += jnp.mean(b @ params)
+    return total
+"""
+    (f,) = [x for x in lint_source(src) if x.check == "hot-op-in-loop"]
+    assert f.severity == "warn" and f.line == 6
+
+
+def test_hot_op_inside_jit_is_fine():
+    src = """
+import jax, jax.numpy as jnp
+@jax.jit
+def step(params, batches):
+    for b in batches:                 # unrolled at trace time
+        params = params - jnp.mean(b)
+    return params
+"""
+    assert "hot-op-in-loop" not in _checks(lint_source(src))
+
+
+def test_data_movement_in_loop_is_fine():
+    src = """
+import jax.numpy as jnp
+def loop(step, batches):
+    for b in batches:
+        out = step(jnp.asarray(b))    # host->device staging is normal
+    return out
+"""
+    assert lint_source(src) == []
+
+
+def test_closure_in_loop_body_not_flagged():
+    src = """
+import jax.numpy as jnp
+def build(widths):
+    fns = []
+    for w in widths:
+        def f(x, w=w):
+            return jnp.exp(x) * w     # runs later, not per-iteration
+        fns.append(f)
+    return fns
+"""
+    assert "hot-op-in-loop" not in _checks(lint_source(src))
+
+
+def test_collective_without_shard_map_is_error():
+    src = """
+from jax import lax
+def bad(x):
+    return lax.psum(x, "dp")
+"""
+    (f,) = [x for x in lint_source(src)
+            if x.check == "collective-outside-shard-map"]
+    assert f.severity == SEV_ERROR
+
+
+def test_collective_with_shard_map_is_fine():
+    src = """
+from jax import lax
+from distributed_training_sandbox_tpu.ops import smap
+def good(mesh, specs):
+    return smap(lambda x: lax.psum(x, "dp"), mesh, specs, specs)
+"""
+    assert lint_source(src) == []
+
+
+def test_step_jit_without_donation_warns():
+    src = """
+import jax
+def loss(p, b):
+    return p
+train_step = jax.jit(loss)
+"""
+    (f,) = [x for x in lint_source(src)
+            if x.check == "step-jit-missing-donation"]
+    assert f.severity == "warn"
+    # donation (either spelling) silences it
+    ok = src.replace("jax.jit(loss)", "jax.jit(loss, donate_argnums=(0,))")
+    assert lint_source(ok) == []
+    # non-step bindings are not the step-loop pattern
+    other = src.replace("train_step =", "eval_fn =")
+    assert "step-jit-missing-donation" not in _checks(lint_source(other))
+
+
+def test_syntax_error_reported_not_raised(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    (f,) = lint_file(p)
+    assert f.check == "syntax" and f.severity == SEV_ERROR
+
+
+def test_shipped_scripts_have_no_errors():
+    """The gate scripts/lint_sharding.py enforces in CI: the current
+    scripts tree carries zero error-severity pitfalls."""
+    findings = lint_tree(SCRIPTS_DIR)
+    errors = [f for f in findings if f.severity == SEV_ERROR]
+    assert errors == [], [f.to_dict() for f in errors]
+
+
+def test_lint_tree_walks_seeded_dir(tmp_path):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    (tmp_path / "bad.py").write_text(
+        "from jax import lax\ny = lax.psum(1, 'dp')\n")
+    findings = lint_tree(tmp_path)
+    assert _checks(findings) == {"collective-outside-shard-map"}

@@ -1,0 +1,56 @@
+"""Recompile detector: steady-state steps stay on one trace; shape- or
+dtype-churned steps are flagged; non-jit callables degrade gracefully."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_training_sandbox_tpu.analysis import watch_recompiles
+from distributed_training_sandbox_tpu.analysis.recompile import (
+    jit_cache_size)
+
+pytestmark = pytest.mark.contracts
+
+
+def test_stable_step_is_clean():
+    step = jax.jit(lambda x: x * 2.0)
+    report = watch_recompiles(step, (jnp.ones((4,)),), n_steps=4)
+    assert report.supported and report.ok
+    assert report.retraces_after_settle == 0
+
+
+def test_shape_churn_is_flagged():
+    step = jax.jit(lambda x: x * 2.0)
+    state = {"n": 3}
+
+    def advance(args, out):
+        state["n"] += 1                      # new shape every step ->
+        return (jnp.ones((state["n"],)),)    # a retrace every step
+
+    report = watch_recompiles(step, (jnp.ones((3,)),), n_steps=4,
+                              advance=advance)
+    assert report.supported and not report.ok
+    assert report.retraces_after_settle >= 1
+    assert "RECOMPILED" in report.summary()
+
+
+def test_settle_step_allowed():
+    """The one legitimate retrace: step 1 re-specializes when outputs
+    (committed/weak-type-resolved) replace host-built inputs — exactly
+    what feeding a train step its own state does.  Growth beyond that
+    is the failure."""
+    step = jax.jit(lambda x: x + 1)
+    # int32 -> weak-type change on first feedback, then stable
+    report = watch_recompiles(step, (3,), n_steps=4,
+                              advance=lambda a, out: (out,))
+    assert report.supported and report.ok
+
+
+def test_unsupported_callable_degrades():
+    def plain(x):
+        return x
+
+    report = watch_recompiles(plain, (1,), n_steps=2)
+    assert not report.supported
+    assert report.ok  # unsupported never fails the caller
+    assert jit_cache_size(plain) is None
