@@ -54,9 +54,16 @@ REF_TOK_S = 3000.0          # reference fsdp/train_fsdp.py:86 (2×A100-80GB)
 REF_DEVICES = 2
 SEQ = 8192
 
-# (row name, TransformerConfig overrides, step-maker kwargs, batch scale)
+# (row name, TransformerConfig overrides, step-maker kwargs, batch scale
+#  [, measure kwargs])
 KNOB_MATRIX = [
     ("explicit_reshard", {}, {"reshard_after_forward": True}, 1),
+    # pump off: block_until_ready + host float per step — the old
+    # synchronous loop shape.  A/B twin of explicit_reshard (identical
+    # knobs, per-step host sync added); the delta is what the async step
+    # pump buys, recorded in the JSON as "pump_ab".
+    ("explicit_reshard_syncstep", {}, {"reshard_after_forward": True}, 1,
+     {"sync_each_step": True}),
     ("explicit_noreshard", {}, {"reshard_after_forward": False}, 1),
     ("auto", {}, None, 1),                      # None -> pjit-auto variant
     ("explicit_save_attn", {"remat_policy": "save_attn"},
@@ -158,9 +165,12 @@ KNOB_MATRIX = [
 
 def measure(model_name: str, seq: int, batch: int, num_steps: int = 8,
             cfg_overrides: dict | None = None,
-            step_kwargs: dict | None = None):
+            step_kwargs: dict | None = None,
+            sync_each_step: bool = False):
     """Time one knob configuration; ``step_kwargs=None`` selects the
-    pjit-auto variant, a dict the explicit shard_map one."""
+    pjit-auto variant, a dict the explicit shard_map one.
+    ``sync_each_step`` re-adds the per-step host sync (the pre-pump loop
+    shape) for the pump on/off A/B."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -198,6 +208,8 @@ def measure(model_name: str, seq: int, batch: int, num_steps: int = 8,
     t0 = time.perf_counter()
     for _ in range(num_steps):
         shards, opt, loss = step(shards, opt, batch_arrs)
+        if sync_each_step:
+            float(np.asarray(loss))  # sync-ok: the pump-off A/B leg
     np.asarray(loss)
     dt = (time.perf_counter() - t0) / num_steps
     tok_s = batch * seq / dt
@@ -214,10 +226,11 @@ def measure(model_name: str, seq: int, batch: int, num_steps: int = 8,
 def run_matrix(model_name: str, seq: int, base_batch: int):
     """Measure every knob row; rows that fail (OOM) record the error."""
     rows = []
-    for name, cfg_over, step_kw, bscale in KNOB_MATRIX:
+    for name, cfg_over, step_kw, bscale, *mk in KNOB_MATRIX:
         try:
             r = measure(model_name, seq, base_batch * bscale,
-                        cfg_overrides=cfg_over, step_kwargs=step_kw)
+                        cfg_overrides=cfg_over, step_kwargs=step_kw,
+                        **(mk[0] if mk else {}))
             rows.append({"config": name, **r})
         except Exception as e:
             msg = str(e)
@@ -272,6 +285,14 @@ def main():
         return
     best = max(good, key=lambda r: r["tflops_per_device"])
     ref = reference_tflops_per_device()
+    by_cfg = {r["config"]: r for r in good}
+    pump_ab = None
+    if {"explicit_reshard", "explicit_reshard_syncstep"} <= set(by_cfg):
+        on = by_cfg["explicit_reshard"]
+        off = by_cfg["explicit_reshard_syncstep"]
+        pump_ab = {"on": on, "off": off,
+                   "speedup": round(off["step_ms"] / on["step_ms"], 3)
+                   if on["step_ms"] else None}
     out = {
         "metric": "fsdp_train_tflops_per_device",
         "value": best["tflops_per_device"],
@@ -280,6 +301,7 @@ def main():
         **best,
         "baseline": f"reference FSDP2 SmolLM3-3B seq8192 2xA100 "
                     f"{REF_TOK_S:.0f} tok/s = {ref:.1f} TFLOPS/device",
+        "pump_ab": pump_ab,
         "matrix": matrix,
     }
     print(json.dumps(out))

@@ -86,6 +86,34 @@ class CollectiveContract:
 # tests/test_contracts.py, which re-derives several of these by lowering
 # on the CPU mesh).  n = param leaf count throughout.
 
+def ddp_bucket_count(param_bytes: int, bucket_mb: float,
+                     itemsize: int = 4) -> int:
+    """Expected all-reduce *site* count of ``parallel.ddp.bucket_gradients``
+    for one dtype group: the concatenated flat gradient vector is split
+    into exact-capacity chunks of ``bucket_mb`` MB, so the count is just
+    ``ceil(elements / chunk_elements)``.  Mirrors the implementation's
+    integer arithmetic (capacity floors to whole elements)."""
+    cap_elems = max(int(bucket_mb * 2 ** 20) // itemsize, 1)
+    n_elems = -(-int(param_bytes) // itemsize)
+    return max(-(-n_elems // cap_elems), 1)
+
+
+def _ddp_bucketed_counts(c: ContractContext) -> dict:
+    """Bucketed grad sync + loss mean + barrier.  ``bucket_mb`` comes from
+    the run's knobs (ctx.extra); ``dtype_bytes`` (dtype name -> bytes) may
+    refine the formula for mixed-precision trees, else all param bytes are
+    assumed one 4-byte dtype — exact for the fp32 toy models."""
+    import numpy as np
+    bucket_mb = float(c.extra.get("bucket_mb") or 25.0)
+    dtype_bytes = c.extra.get("dtype_bytes")
+    if dtype_bytes:
+        n = sum(ddp_bucket_count(b, bucket_mb, np.dtype(dt).itemsize)
+                for dt, b in dtype_bytes.items())
+    else:
+        n = ddp_bucket_count(c.param_bytes, bucket_mb)
+    return {"all_reduce": n + 2}
+
+
 def _zero1_counts(c: ContractContext) -> dict:
     if c.extra.get("rebuild", "broadcast") == "all_gather":
         return {"all_reduce": c.n_leaves + 2, "all_gather": c.n_leaves}
@@ -108,6 +136,14 @@ CONTRACTS: dict[str, CollectiveContract] = {
         payload_bytes=lambda c: 2 * c.param_bytes,
         description="per-param grad all_reduce; no gathers (params "
                     "replicated at rest)"),
+    # grads flattened per dtype into ~bucket_mb flat buckets, one
+    # all_reduce per bucket (+ loss mean + barrier) — torch DDP's bucketed
+    # sync; count is a closed formula over param bytes and bucket size
+    "ddp_bucketed": CollectiveContract(
+        "ddp_bucketed", ("dp",), _ddp_bucketed_counts,
+        payload_bytes=lambda c: 2 * c.param_bytes,
+        description="ceil(param_bytes/bucket) grad all_reduces over flat "
+                    "buckets + loss mean + barrier; no gathers"),
     # grads all_reduced per param, owner-chunk Adam, per-param rebuild
     "zero1": CollectiveContract(
         "zero1", ("dp",), _zero1_counts,

@@ -18,8 +18,12 @@ from typing import Any, Callable
 
 from .contracts import CONTRACTS, ContractContext
 
-STRATEGIES = ("ddp", "zero1", "zero2", "zero3", "fsdp", "tp", "sp",
-              "moe", "gpipe", "1f1b")
+STRATEGIES = ("ddp", "ddp_bucketed", "zero1", "zero2", "zero3", "fsdp",
+              "tp", "sp", "moe", "gpipe", "1f1b")
+
+# the canonical bucket size for the ddp_bucketed fixture — small enough
+# that the toy MLP needs several buckets, so the formula is exercised
+FIXTURE_BUCKET_MB = 0.05
 
 
 @dataclass
@@ -73,7 +77,7 @@ def build_strategy(strategy: str, *, mesh=None, scale: int = 100,
     n_dev = len(jax.devices())
 
     # ---- toy-MLP strategies over a 1-D dp mesh -------------------------
-    if strategy in ("ddp", "zero1", "zero2", "zero3"):
+    if strategy in ("ddp", "ddp_bucketed", "zero1", "zero2", "zero3"):
         mesh = mesh or make_mesh(register=False)
         params = zero_toy_mlp(key, scale=scale)
         width = 10_000 // scale
@@ -81,13 +85,17 @@ def build_strategy(strategy: str, *, mesh=None, scale: int = 100,
         b = (jax.random.normal(kx, (batch_size, width)),
              jax.random.normal(ky, (batch_size, width)))
         shapes = param_shapes(params, min_numel=256)
+        extra = {"bucket_mb": FIXTURE_BUCKET_MB} \
+            if strategy == "ddp_bucketed" else {}
         ctx = ContractContext.capture(params=params, mesh=mesh,
-                                      n_layers=len(params))
-        if strategy == "ddp":
+                                      n_layers=len(params), **extra)
+        if strategy in ("ddp", "ddp_bucketed"):
             step = make_ddp_train_step(
                 mse_loss,
                 lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3),
-                mesh, "dp")
+                mesh, "dp",
+                bucket_mb=FIXTURE_BUCKET_MB
+                if strategy == "ddp_bucketed" else None)
             args = (params, optim.sgd_init(params), b)
         elif strategy in ("zero1", "zero2"):
             step = make_zero_train_step(mse_loss, mesh, "dp",

@@ -19,6 +19,14 @@ traces it), tuned so the current ``scripts/`` tree is clean at the
   * ``step-jit-missing-donation`` (warn) — ``jax.jit(...)`` bound to a
     ``*step*`` name without ``donate_argnums``: params + optimizer state
     are double-buffered every step.
+  * ``host-sync-in-loop`` — a per-step host synchronization inside a
+    Python loop outside jit: ``jax.block_until_ready``/``local_scalar``
+    (error), or ``float(<...loss...>)`` (warn).  This is the old
+    synchronous-driver pattern the ``runtime`` step pump replaces —
+    route the loop through ``StepPump`` so the host only blocks at the
+    declared sync policy points.  Loops that sync *deliberately*
+    (latency benchmarks, warmup fences) mark the line — or the line
+    above it — with a ``sync-ok`` comment to suppress the finding.
 
 Findings carry a severity; ``scripts/lint_sharding.py`` fails the run
 only on errors (``--strict`` promotes warnings).
@@ -47,6 +55,9 @@ COLLECTIVE_FNS = {
     "ppermute_ring", "barrier",
 }
 SHARD_WRAPPERS = {"shard_map", "smap", "pmap", "shmap", "xmap"}
+# per-step host synchronization calls — the pattern the runtime step
+# pump's sync policy replaces in driver hot loops
+HOST_SYNC_FNS = {"block_until_ready", "local_scalar"}
 
 SEV_ERROR = "error"
 SEV_WARN = "warn"
@@ -140,9 +151,33 @@ class _Visitor(ast.NodeVisitor):
         if (leaf in COLLECTIVE_FNS
                 and root in ("lax", "jax", "C", "collectives")):
             self.collective_calls.append((node.lineno, chain))
+        if self._loop_depth and not self._jit_depth:
+            self._check_host_sync(node, chain, leaf, root)
         if _is_jit_call(node):
             self._check_donation(node)
         self.generic_visit(node)
+
+    def _check_host_sync(self, node: ast.Call, chain: str, leaf: str,
+                         root: str) -> None:
+        """The old synchronous hot-loop shape: a blocking host round-trip
+        every iteration.  Severity: error for the explicit fences
+        (block_until_ready / local_scalar), warn for float(<loss>)."""
+        if leaf in HOST_SYNC_FNS and root in ("jax", leaf):
+            self.findings.append(PitfallFinding(
+                self.path, node.lineno, "host-sync-in-loop", SEV_ERROR,
+                f"{chain}() inside a Python loop — a host sync every "
+                f"step; route the loop through runtime.StepPump's sync "
+                f"policy (or mark a deliberate sync with '# sync-ok')"))
+            return
+        if (isinstance(node.func, ast.Name) and node.func.id == "float"
+                and node.args):
+            arg = _attr_chain(node.args[0])
+            if "loss" in arg.lower():
+                self.findings.append(PitfallFinding(
+                    self.path, node.lineno, "host-sync-in-loop", SEV_WARN,
+                    f"float({arg}) inside a Python loop forces a device "
+                    f"round-trip per step; let the step pump resolve "
+                    f"losses at its sync points"))
 
     def visit_Name(self, node: ast.Name):
         if node.id in SHARD_WRAPPERS:
@@ -185,7 +220,17 @@ def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
     _annotate_assignments(tree)
     v = _Visitor(path)
     v.visit(tree)
-    findings = list(v.findings)
+    # 'sync-ok' pragma: a deliberate per-iteration sync (latency bench,
+    # warmup fence) on the flagged line or the line above suppresses the
+    # host-sync-in-loop finding — nothing else
+    lines = src.splitlines()
+    def _sync_ok(line_no: int) -> bool:
+        return any("sync-ok" in lines[i]
+                   for i in (line_no - 1, line_no - 2)
+                   if 0 <= i < len(lines))
+    findings = [f for f in v.findings
+                if not (f.check == "host-sync-in-loop"
+                        and _sync_ok(f.line))]
     if v.collective_calls and not v.uses_shard_wrapper:
         line, chain = v.collective_calls[0]
         findings.append(PitfallFinding(

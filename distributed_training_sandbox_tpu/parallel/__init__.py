@@ -6,6 +6,7 @@ from . import tensor  # noqa: F401
 from . import expert  # noqa: F401
 from .ddp import (  # noqa: F401
     sync_gradients,
+    bucket_gradients,
     broadcast_params,
     params_sync_error,
     make_ddp_train_step,

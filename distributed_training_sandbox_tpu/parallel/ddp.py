@@ -51,6 +51,43 @@ def sync_gradients(grads, axis: str):
     return C.tree_all_reduce(grads, axis, mean=True)
 
 
+def bucket_gradients(grads, axis: str, bucket_mb: float, *,
+                     mean: bool = True):
+    """torch-DDP-style bucketed gradient sync: flatten the leaves of each
+    dtype (in tree order) into one vector, split it into ``~bucket_mb``-MB
+    flat chunks, all_reduce each chunk, and scatter the results back into
+    the original tree.
+
+    Versus the per-leaf :func:`sync_gradients` this trades n-leaves small
+    all_reduces for ``ceil(bytes / bucket)`` large ones — the payload-
+    shape knob EQuARX (arXiv:2506.17615) treats as first-class; the site
+    count is pinned by the ``ddp_bucketed`` CollectiveContract
+    (``analysis.contracts.ddp_bucket_count``).  Deterministic bucketing
+    (exact-capacity splits of the concatenated vector, not greedy leaf
+    packing) is what makes that count a closed formula over total param
+    bytes and bucket size."""
+    leaves, treedef = jax.tree.flatten(grads)
+    cap_bytes = max(int(bucket_mb * 2 ** 20), 1)
+    by_dtype: dict = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    out = list(leaves)
+    for dt, idxs in by_dtype.items():
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+        cap = max(cap_bytes // dt.itemsize, 1)
+        chunks = [C.all_reduce(flat[s:s + cap], axis)
+                  for s in range(0, flat.size, cap)]
+        red = jnp.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+        if mean:
+            red = red / C.axis_size(axis)
+        off = 0
+        for i in idxs:
+            sz = leaves[i].size
+            out[i] = red[off:off + sz].reshape(leaves[i].shape)
+            off += sz
+    return jax.tree.unflatten(treedef, out)
+
+
 def shard_range(n: int, ws: int, rank: int) -> range:
     """Contiguous per-rank dataset shard, remainder to the leading ranks —
     twin of ``DDP/ddp.py:104-112``."""
@@ -67,6 +104,7 @@ def make_ddp_train_step(
     *,
     with_barrier: bool = True,
     donate: bool = True,
+    bucket_mb: float | None = None,
 ):
     """Build the jitted DDP step: (params, opt_state, batch) ->
     (params, opt_state, loss).
@@ -76,13 +114,19 @@ def make_ddp_train_step(
     sharded on ``axis`` (global batch dim); params/opt state are replicated.
     ``with_barrier`` appends the 1-elem-psum step barrier the reference uses
     for trace isolation (``zero/zero1.py:184``, README.md:11-12).
+    ``bucket_mb`` switches the per-param gradient all_reduce to
+    :func:`bucket_gradients`' flat ~N MB buckets (the ``ddp_bucketed``
+    choreography).
     """
 
     def step(params, opt_state, batch):
         with scope("forward_backward"):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         with scope("sync_grads"):
-            grads = sync_gradients(grads, axis)
+            if bucket_mb:
+                grads = bucket_gradients(grads, axis, bucket_mb)
+            else:
+                grads = sync_gradients(grads, axis)
             # the loss is reported averaged over the global batch, like the
             # reference's rank-0 print of its local loss post-allreduce-free
             loss = C.all_reduce(loss, axis, mean=True)

@@ -1,0 +1,24 @@
+"""The async step pump — the shared hot-loop machinery every strategy
+driver runs through.
+
+The reference drivers (and this repo's, before this package) ran a
+strictly synchronous loop: host batch prep, an unsharded ``jnp.asarray``
+transfer, dispatch, then ``jax.block_until_ready(loss)`` + ``float(loss)``
+on every step — the TPU idles during data movement and the host idles
+during compute.  This package is the overlap layer:
+
+  * :class:`DevicePrefetcher` (``prefetch.py``) — the host batch pipeline
+    in a background thread, double-buffering batches onto the mesh via
+    sharding-aware ``jax.device_put``;
+  * :class:`StepPump` (``pump.py``) — bounded in-flight dispatch with a
+    declared sync policy: losses retire as device arrays and the host
+    only blocks at profile-schedule boundaries, every ``--sync-every``
+    steps, and at loop exit.
+
+``scripts/lint_sharding.py`` enforces the migration: a per-step
+``jax.block_until_ready``/``float(loss)`` in a driver hot loop is now a
+lint error unless routed through the pump (or marked ``# sync-ok``).
+"""
+
+from .prefetch import DevicePrefetcher, sharded_put  # noqa: F401
+from .pump import StepPump  # noqa: F401

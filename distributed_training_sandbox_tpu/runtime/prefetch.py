@@ -1,0 +1,149 @@
+"""Sharding-aware background batch prefetch.
+
+The host half of the overlap story: while the device executes step ``i``,
+a producer thread is already collating batch ``i+1`` and staging it onto
+the mesh with ``jax.device_put`` under the batch's *training* sharding
+(e.g. ``P("dp")``), so the transfer happens concurrently with compute and
+the array arrives committed — no replicated/uncommitted ``jnp.asarray``
+put in the hot loop, no device-side reshard on first use.
+
+Semantics:
+  * order-preserving: the prefetcher yields exactly the wrapped
+    iterator's sequence (same seed ⇒ bitwise-identical batches vs. eager
+    iteration — tested);
+  * bounded: at most ``depth`` staged batches exist at once (the queue
+    blocks the producer), default 2 = classic double buffering;
+  * crash-clean: ``close()`` (also run by ``__exit__`` on loop
+    exceptions) stops the producer, drains the queue and joins the
+    thread — no leaked thread, no orphaned device buffers;
+  * error-transparent: an exception in the host pipeline re-raises at
+    the consumer's next ``next()``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+
+def sharded_put(batch, mesh, spec):
+    """``jax.device_put`` every array leaf of ``batch`` with
+    ``NamedSharding(mesh, spec)``.  ``spec`` is one ``PartitionSpec``
+    applied to all leaves (the batch-dim sharding every strategy here
+    uses), or a pytree of specs matching ``batch``'s structure."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None:
+        return batch
+    if isinstance(spec, PartitionSpec) or spec is None:
+        sh = NamedSharding(mesh, spec or PartitionSpec())
+        return jax.tree.map(lambda a: jax.device_put(a, sh), batch)
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), batch, spec)
+
+
+class _End:
+    """Sentinel: iterator exhausted."""
+
+
+class _Err:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class DevicePrefetcher(Iterator[Any]):
+    """Iterate ``it`` through a ``depth``-bounded background pipeline.
+
+    ``mesh``/``spec`` select the sharded ``device_put`` (see
+    :func:`sharded_put`); with ``mesh=None`` this is a plain host-side
+    prefetch thread (the pipeline drivers' mode — their stage transfer is
+    host-mediated).  ``transform`` optionally replaces the put entirely
+    (receives the host batch, returns what the consumer should get).
+    """
+
+    def __init__(self, it: Iterable[Any], *, mesh=None, spec=None,
+                 depth: int = 2,
+                 transform: Callable[[Any], Any] | None = None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.depth = depth
+        self._it = iter(it)
+        self._put = transform if transform is not None \
+            else (lambda b: sharded_put(b, mesh, spec))
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._produce, name="device-prefetcher", daemon=True)
+        self._thread.start()
+
+    # ---- producer (background thread) -----------------------------------
+    def _produce(self) -> None:
+        try:
+            for item in self._it:
+                staged = self._put(item)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+            self._enqueue_final(_End())
+        except BaseException as e:  # noqa: BLE001 - relayed to consumer
+            self._enqueue_final(_Err(e))
+
+    def _enqueue_final(self, token) -> None:
+        while not self._stop.is_set():
+            try:
+                self._q.put(token, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # ---- consumer --------------------------------------------------------
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        if self._closed:
+            raise StopIteration
+        item = self._q.get()
+        if isinstance(item, _End):
+            self.close()
+            raise StopIteration
+        if isinstance(item, _Err):
+            self.close()
+            raise item.exc
+        return item
+
+    # ---- lifecycle -------------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self) -> None:
+        """Stop the producer and join it.  Idempotent; safe to call from
+        an exception handler mid-loop (the ``with`` form does)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # drain so a producer blocked on a full queue sees the stop flag
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        self._thread.join()
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -127,6 +127,10 @@ def run_row(rec: dict) -> dict:
     sp = summ.get("comm_split") or {}
     if sp.get("comm_fraction") is not None:
         row["comm_fraction"] = sp["comm_fraction"]
+    if sp.get("overlap_fraction") is not None:
+        row["overlap_fraction"] = sp["overlap_fraction"]
+    if summ.get("host_sync_count") is not None:
+        row["host_sync_count"] = summ["host_sync_count"]
     return row
 
 
@@ -195,9 +199,9 @@ def render_table(rows: list[dict]) -> str:
     if not rows:
         return "_no runs found_"
     out = ["| run | strategy | model | seq | batch | dev | steps | "
-           "step ms | tok/s | TFLOPS/dev | comm % | collectives/step | "
-           "status |",
-           "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
+           "step ms | tok/s | TFLOPS/dev | comm % | overlap % | "
+           "host syncs | collectives/step | status |",
+           "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
     for r in sorted(rows, key=lambda r: (r.get("strategy") or "",
                                          str(r.get("model")),
                                          r.get("run_id") or "")):
@@ -209,6 +213,7 @@ def render_table(rows: list[dict]) -> str:
         elif r.get("contract_ok") is False:
             cc_cell += " ✗"
         comm = r.get("comm_fraction")
+        ovl = r.get("overlap_fraction")
         out.append(
             f"| {r.get('run_id', '—')} | {r.get('strategy', '—')} "
             f"| {r.get('model') or '—'} "
@@ -220,6 +225,8 @@ def render_table(rows: list[dict]) -> str:
             f"| {_fmt(r.get('tokens_per_second'), '.0f')} "
             f"| {_fmt(r.get('tflops_per_device'), '.2f')} "
             f"| {_fmt(100 * comm if comm is not None else None, '.1f')} "
+            f"| {_fmt(100 * ovl if ovl is not None else None, '.1f')} "
+            f"| {_fmt(r.get('host_sync_count'), 'd')} "
             f"| {cc_cell} | {r.get('status', '—')} |")
     return "\n".join(out)
 

@@ -78,6 +78,12 @@ class TelemetryRun:
         self._tokens_total = 0
         self._t_prev: float | None = None
         self._finalized = False
+        # deferred (device-array) losses: events buffered here until the
+        # pump's next sync point resolves them (see flush())
+        self._deferred: list[tuple[dict, object]] = []
+        # set by StepPump.close(); lands in summary.json
+        self.host_sync_count: int | None = None
+        self.host_sync_breakdown: dict | None = None
 
     @staticmethod
     def _unique_run_id(results_dir: str, strategy: str,
@@ -124,7 +130,13 @@ class TelemetryRun:
     def step(self, *, loss=None, tokens: int | None = None,
              tracker_metrics: dict | None = None, **extra) -> None:
         """Record one optimizer step.  Also advances the owned profiler,
-        so the loop needs no separate ``prof.step()`` call."""
+        so the loop needs no separate ``prof.step()`` call.
+
+        ``loss`` may be a host float (written through immediately, the
+        classic path) or a *device array* still in flight — then the
+        event is buffered with a null loss and resolved at the next
+        :meth:`flush` (the async pump's sync points), so the JSONL
+        schema is unchanged and rows stay in step order."""
         now = time.perf_counter()
         dt = now - self._t_prev if self._t_prev is not None else None
         self._t_prev = now
@@ -132,8 +144,7 @@ class TelemetryRun:
             self.profiler.step()
         tm = tracker_metrics or {}
         step_time = tm.get("last_step_time_s") or dt
-        if loss is not None:
-            self._losses.append(float(loss))
+        deferred = loss is not None and hasattr(loss, "block_until_ready")
         if step_time is not None:
             self._step_times.append(float(step_time))
         if tokens:
@@ -142,10 +153,43 @@ class TelemetryRun:
             self._last_tracker_metrics = tm
         idx = self._step_idx
         self._step_idx += 1
+        if deferred:
+            ev = step_event(idx, loss=None, tokens=tokens,
+                            step_time_s=step_time,
+                            tracker_metrics=tracker_metrics, **extra)
+            self._deferred.append((ev, loss))
+            return
+        if self._deferred:       # keep steps.jsonl in step order
+            self.flush()
+        if loss is not None:
+            self._losses.append(float(loss))
         if self.writer is not None:
             self.writer.append_step(step_event(
                 idx, loss=loss, tokens=tokens, step_time_s=step_time,
                 tracker_metrics=tracker_metrics, **extra))
+
+    def flush(self, up_to: int | None = None) -> None:
+        """Resolve buffered deferred-loss events (oldest first; all of
+        them, or the first ``up_to``) and hand them to the writer.  The
+        caller — the pump at a sync point, or finalize — is responsible
+        for the losses being (near-)ready; resolution of a poisoned
+        array degrades to a null loss rather than raising."""
+        n = len(self._deferred) if up_to is None \
+            else min(up_to, len(self._deferred))
+        for _ in range(n):
+            ev, arr = self._deferred.pop(0)
+            try:
+                from ..utils.mesh import local_scalar
+                lf = local_scalar(arr)
+            except Exception:   # crash path: keep the original exception
+                lf = None
+            if lf is not None:
+                ev["loss"] = lf
+                self._losses.append(lf)
+            if self.writer is not None:
+                self.writer.append_step(ev)
+        if self.writer is not None:
+            self.writer.flush()
 
     # ---- end-of-run -----------------------------------------------------
     def _aggregates(self) -> dict:
@@ -176,6 +220,10 @@ class TelemetryRun:
         if self._finalized:
             return None
         self._finalized = True
+        try:
+            self.flush()     # resolve any still-deferred losses
+        except Exception:
+            pass
         if not self.enabled or self.writer is None:
             return None
         summary: dict = {
@@ -192,6 +240,11 @@ class TelemetryRun:
             if k in cfg:
                 summary[k] = cfg[k]
         summary.update(self._aggregates())
+        if self.host_sync_count is not None:
+            # the pump's instrumented blocking events (policy barriers +
+            # backpressure waits) — the async-dispatch acceptance metric
+            summary["host_sync_count"] = self.host_sync_count
+            summary["host_sync_breakdown"] = self.host_sync_breakdown
         summary.update(extra)
         # post-run profiling hook: comm/compute split from the trace the
         # owned Profiler just flushed
@@ -209,6 +262,8 @@ class TelemetryRun:
                     "compute_us": sp.compute_us,
                     "other_us": sp.other_us,
                     "comm_fraction": sp.comm_fraction,
+                    "overlap_us": sp.overlap_us,
+                    "overlap_fraction": sp.overlap_fraction,
                     "trace_file": sp.trace_file,
                 }
         self.writer.write_summary(summary)

@@ -11,7 +11,15 @@ Layout contract (read back by ``telemetry.report`` / ``scripts/report.py``):
 
 The writer is deliberately dumb — no rank logic, no aggregation; the
 rank-0-only policy and the summary contents live in ``TelemetryRun``.
-Steps are flushed per line so a crash loses at most the in-flight event.
+
+Step appends are buffered and flushed every :data:`FLUSH_EVERY` (= 32)
+events, plus explicitly via ``flush()`` (the pump does this at every
+sync point) and on ``close()`` — which every crash path reaches through
+``TelemetryRun.finalize``.  The durability contract is therefore:
+an *exception* loses nothing; a hard kill (SIGKILL/power) loses at most
+the ≤ 32 in-flight events since the last flush.  The previous
+line-buffered mode paid one write+flush syscall pair per step in the
+hot loop for a guarantee only the hard-kill case ever used.
 """
 
 from __future__ import annotations
@@ -19,17 +27,21 @@ from __future__ import annotations
 import json
 import os
 
+FLUSH_EVERY = 32
+
 
 class MetricsWriter:
     MANIFEST = "manifest.json"
     STEPS = "steps.jsonl"
     SUMMARY = "summary.json"
 
-    def __init__(self, run_dir: str):
+    def __init__(self, run_dir: str, flush_every: int = FLUSH_EVERY):
         self.run_dir = run_dir
         os.makedirs(run_dir, exist_ok=True)
         self._steps_f = None
         self.steps_written = 0
+        self.flush_every = max(int(flush_every), 1)
+        self._unflushed = 0
 
     # ---- artifacts ------------------------------------------------------
     def write_manifest(self, manifest) -> str:
@@ -43,9 +55,17 @@ class MetricsWriter:
     def append_step(self, event: dict) -> None:
         if self._steps_f is None:
             self._steps_f = open(os.path.join(self.run_dir, self.STEPS),
-                                 "a", buffering=1)
+                                 "a")
         self._steps_f.write(json.dumps(event, default=str) + "\n")
         self.steps_written += 1
+        self._unflushed += 1
+        if self._unflushed >= self.flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        if self._steps_f is not None and self._unflushed:
+            self._steps_f.flush()
+        self._unflushed = 0
 
     def write_summary(self, summary: dict) -> str:
         path = os.path.join(self.run_dir, self.SUMMARY)
@@ -56,6 +76,7 @@ class MetricsWriter:
 
     def close(self) -> None:
         if self._steps_f is not None:
+            self.flush()
             self._steps_f.close()
             self._steps_f = None
 

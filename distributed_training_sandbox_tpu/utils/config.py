@@ -45,6 +45,15 @@ class TrainConfig:
     profile: bool = True
     results_dir: str = field(default_factory=default_results_dir)
     telemetry: bool = True
+    # --- async step pump (runtime/) --------------------------------------
+    # dispatch: "async" = bounded in-flight dispatch, losses retired as
+    # device arrays, host blocks only at the sync policy points;
+    # "sync" = the classic block-every-step loop (the A/B baseline).
+    dispatch: str = "async"
+    prefetch_depth: int = 2      # DevicePrefetcher staging depth
+    sync_every: int = 10         # barrier every N steps (0 = exit only)
+    max_in_flight: int = 16      # bounded dispatch window (backpressure)
+    bucket_mb: float | None = None  # ddp: all-reduce grads in ~N MB buckets
 
     @classmethod
     def from_args(cls, argv=None, **overrides) -> "TrainConfig":
@@ -99,4 +108,25 @@ def build_argparser(parser: argparse.ArgumentParser | None = None):
                    action="store_false", default=None,
                    help="disable the manifest/steps.jsonl/summary.json "
                         "run artifacts")
+    p.add_argument("--dispatch", dest="dispatch",
+                   choices=["async", "sync"], default=None,
+                   help="step pump mode: bounded async dispatch (default) "
+                        "or the classic block-every-step loop")
+    p.add_argument("--prefetch-depth", dest="prefetch_depth", type=int,
+                   default=None,
+                   help="batches staged ahead by the DevicePrefetcher "
+                        "(default 2 = double buffering)")
+    p.add_argument("--sync-every", dest="sync_every", type=int,
+                   default=None,
+                   help="async mode: host barrier every N steps "
+                        "(0 = only at profile boundaries and loop exit)")
+    p.add_argument("--max-in-flight", dest="max_in_flight", type=int,
+                   default=None,
+                   help="async mode: bound on dispatched steps with "
+                        "unretired losses")
+    p.add_argument("--bucket-mb", dest="bucket_mb", type=float,
+                   default=None,
+                   help="ddp: flatten per-dtype gradient leaves into "
+                        "~N MB flat buckets before the all-reduce "
+                        "(torch-DDP style; default: per-leaf)")
     return p

@@ -76,6 +76,15 @@ class Profiler:
         self.stop()
         return False
 
+    def pending_transition(self) -> bool:
+        """True iff the NEXT ``step()`` call will start or stop a trace.
+        The async step pump barriers exactly there, so traces bound the
+        intended steps even with work in flight."""
+        if not self.enabled:
+            return False
+        return (self.schedule.phase(self._step + 1) == "trace") \
+            != self._tracing
+
     def step(self) -> None:
         if not self.enabled:
             return

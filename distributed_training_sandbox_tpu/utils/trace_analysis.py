@@ -55,6 +55,10 @@ class CommSplit:
     trace_file: str
     top_comm: list
     top_compute: list
+    # wall-clock microseconds during which a comm event and a compute
+    # event were running concurrently (different trace rows) — the
+    # overlap the async pump/prefetcher exist to create
+    overlap_us: float = 0.0
 
     @property
     def total_us(self) -> float:
@@ -64,6 +68,12 @@ class CommSplit:
     def comm_fraction(self) -> float:
         return self.comm_us / self.total_us if self.total_us else 0.0
 
+    @property
+    def overlap_fraction(self) -> float:
+        """Fraction of comm time hidden under concurrent compute.
+        0.0 on a fully serialized schedule (e.g. the CPU-sim backend)."""
+        return self.overlap_us / self.comm_us if self.comm_us else 0.0
+
     def report(self, label: str = "") -> str:
         """The reference's print format (zero2.py:219-228): absolute times
         + overhead %."""
@@ -72,13 +82,39 @@ class CommSplit:
                 f"comm {self.comm_us / 1e3:.2f} ms, "
                 f"compute {self.compute_us / 1e3:.2f} ms "
                 f"-> communication overhead {pct:.1f}% of categorized "
-                f"device time")
+                f"device time, {100.0 * self.overlap_fraction:.1f}% of "
+                f"comm overlapped with compute")
 
 
 def latest_trace_file(trace_dir: str) -> str | None:
     files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
                       recursive=True)
     return max(files, key=os.path.getmtime) if files else None
+
+
+def interval_overlap_us(comm_iv: list, compute_iv: list) -> float:
+    """Total microseconds during which any ``comm`` interval and any
+    ``compute`` interval (each ``(start, end)``) run concurrently.
+    Compute intervals are merged first so stacked fusions don't double-
+    count; each comm interval then contributes its intersection with the
+    merged compute timeline."""
+    if not comm_iv or not compute_iv:
+        return 0.0
+    merged: list[list[float]] = []
+    for s, e in sorted(compute_iv):
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    total = 0.0
+    for cs, ce in comm_iv:
+        for ms, me in merged:
+            if ms >= ce:
+                break
+            if me <= cs:
+                continue
+            total += min(ce, me) - max(cs, ms)
+    return total
 
 
 def split_from_trace(trace_dir: str, top_n: int = 5) -> CommSplit | None:
@@ -90,21 +126,29 @@ def split_from_trace(trace_dir: str, top_n: int = 5) -> CommSplit | None:
     events = json.load(gzip.open(tf, "rt"))["traceEvents"]
     comm: dict[str, float] = {}
     compute: dict[str, float] = {}
+    comm_iv: list = []
+    compute_iv: list = []
     other = 0.0
     for e in events:
         if e.get("ph") != "X":
             continue
         name = e.get("name", "")
         dur = float(e.get("dur", 0.0))
+        ts = e.get("ts")
+        iv = (float(ts), float(ts) + dur) if ts is not None and dur else None
         # Comm first: collective stall events ("megacore-fusion-wait",
         # "Rendezvous") must win over _IGNORE's generic host-wait patterns
         # (the docstring's methodology note depends on it).
         if _COMM.search(name):
             comm[name] = comm.get(name, 0.0) + dur
+            if iv:
+                comm_iv.append(iv)
         elif _IGNORE.search(name):
             continue
         elif _COMPUTE.search(name):
             compute[name] = compute.get(name, 0.0) + dur
+            if iv:
+                compute_iv.append(iv)
         else:
             other += dur
     top = lambda d: sorted(d.items(), key=lambda kv: -kv[1])[:top_n]
@@ -115,6 +159,7 @@ def split_from_trace(trace_dir: str, top_n: int = 5) -> CommSplit | None:
         trace_file=tf,
         top_comm=top(comm),
         top_compute=top(compute),
+        overlap_us=interval_overlap_us(comm_iv, compute_iv),
     )
 
 

@@ -2,6 +2,15 @@
 (reference ``fsdp/utils.py:129-193``): restarts its clock once warmup steps
 have passed, then reports tokens/s, steps/s, per-device TFLOPS from the
 analytic FLOPs model, and peak device memory.
+
+Peak memory is *sampled*, not polled: ``device_memory_stats()`` is a
+device round-trip, and the old behaviour of querying it inside every
+``metrics()`` call put one on the critical path of every step.  The
+allocator peak is monotone, so the tracker now samples it every
+``memory_sample_every`` steps (default 10) and once more at finalize
+(``metrics(sample_memory=True)`` — the step pump does this at close);
+between samples ``metrics()`` reuses the cached value.  The returned
+dict shape is unchanged.
 """
 
 from __future__ import annotations
@@ -15,10 +24,12 @@ from .memory import all_devices_memory_gb, device_memory_stats, GB
 
 class PerformanceTracker:
     def __init__(self, warmup_steps: int = 5, flops_per_token: float | None = None,
-                 num_devices: int | None = None):
+                 num_devices: int | None = None,
+                 memory_sample_every: int = 10):
         self.warmup_steps = warmup_steps
         self.flops_per_token = flops_per_token
         self.num_devices = num_devices or jax.device_count()
+        self.memory_sample_every = max(int(memory_sample_every), 1)
         self.step_count = 0
         self.tokens = 0
         self.total_loss = 0.0
@@ -27,11 +38,16 @@ class PerformanceTracker:
         self._warmed_up = warmup_steps == 0
         self._prev_step_t = self.start
         self.last_step_time_s: float | None = None
+        self._peak_gb: float | None = None
+        self._mem_all: dict | None = None
+        self._mem_sampled = False
 
     def step(self, tokens: int, loss: float | None = None) -> dict | None:
         """Record one optimizer step of ``tokens`` tokens.  Returns the metric
         dict once past warmup, else None.  Restart-at-warmup matches reference
-        ``fsdp/utils.py:155-159``."""
+        ``fsdp/utils.py:155-159``.  ``loss`` may be omitted and supplied
+        later via :meth:`record_loss` (the async pump resolves losses at
+        its sync points, not per step)."""
         now = time.perf_counter()
         self.last_step_time_s = now - self._prev_step_t
         self._prev_step_t = now
@@ -47,11 +63,24 @@ class PerformanceTracker:
             return None
         self.tokens += tokens
         if loss is not None:
-            self.total_loss += float(loss)
-            self.loss_count += 1
-        return self.metrics()
+            self.record_loss(loss)
+        return self.metrics(
+            sample_memory=self.step_count % self.memory_sample_every == 0)
 
-    def metrics(self) -> dict:
+    def record_loss(self, loss: float) -> None:
+        """Fold one resolved loss into the running average — the deferred
+        twin of passing ``loss=`` to :meth:`step`."""
+        self.total_loss += float(loss)
+        self.loss_count += 1
+
+    def _sample_memory(self) -> None:
+        peak = device_memory_stats()["peak_bytes_in_use"]
+        if peak:
+            self._peak_gb = peak / GB
+            self._mem_all = all_devices_memory_gb()
+        self._mem_sampled = True
+
+    def metrics(self, *, sample_memory: bool = False) -> dict:
         elapsed = max(time.perf_counter() - self.start, 1e-9)
         steps_per_second = self.step_count / elapsed
         tokens_per_second = self.tokens / elapsed
@@ -73,8 +102,9 @@ class PerformanceTracker:
             out["tflops_per_device"] = (
                 tokens_per_second * self.flops_per_token / self.num_devices / 1e12
             )
-        peak = device_memory_stats()["peak_bytes_in_use"]
-        if peak:
-            out["peak_memory_gb"] = peak / GB
-            out["memory_all_devices"] = all_devices_memory_gb()
+        if sample_memory or not self._mem_sampled:
+            self._sample_memory()
+        if self._peak_gb is not None:
+            out["peak_memory_gb"] = self._peak_gb
+            out["memory_all_devices"] = self._mem_all
         return out

@@ -22,29 +22,36 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def _time_steps(step_fn, state, batch, n_steps, telem=None, label="",
-                tokens_per_step=None):
+                tokens_per_step=None, cfg=None):
     """Run n_steps (first is untimed warmup/compile, like the reference's
     explicit warmup step, zero1.py:118-125). Returns (state, losses, sec/step).
     ``telem`` is the leg's TelemetryRun — it records each step AND advances
-    the profiler it owns."""
+    the profiler it owns.  The loop runs through the async step pump
+    (``cfg.dispatch``/``cfg.sync_every``/``cfg.max_in_flight``); the
+    timed window closes only after the pump drains, so sec/step stays an
+    honest amortized figure."""
     import jax
-    from distributed_training_sandbox_tpu.utils import local_scalar
+    from distributed_training_sandbox_tpu.runtime import StepPump
     params, opt = state
-    losses = []
     t0 = None
-    for i in range(max(n_steps, 2)):
-        params, opt, loss = step_fn(params, opt, batch)
-        jax.block_until_ready(loss)
-        if i == 0:
-            t0 = time.perf_counter()  # discard compile step
-        else:
-            losses.append(local_scalar(loss))
-        if telem is not None:
-            telem.step(loss=losses[-1] if losses else None,
-                       tokens=tokens_per_step)
+    pump = StepPump(telem=telem,
+                    mode=cfg.dispatch if cfg else "async",
+                    sync_every=cfg.sync_every if cfg else 10,
+                    max_in_flight=cfg.max_in_flight if cfg else 16)
+    with pump:
+        for i in range(max(n_steps, 2)):
+            params, opt, loss = step_fn(params, opt, batch)
+            if i == 0:
+                # compile fence: discard the jit step from the timed
+                # window, as the reference's explicit warmup does
+                jax.block_until_ready(loss)  # sync-ok: pre-timing fence
+                t0 = time.perf_counter()
+            pump.emit(loss, tokens=tokens_per_step)
     dt = (time.perf_counter() - t0) / max(n_steps - 1, 1)
+    losses = [l for idx, l in pump.resolved if idx > 0]
     print(f"[{label}] {len(losses)} timed steps, {dt * 1e3:.2f} ms/step, "
-          f"final loss {losses[-1]:.6f}")
+          f"final loss {losses[-1]:.6f} "
+          f"(host syncs {pump.host_sync_count})")
     return (params, opt), losses, dt
 
 
@@ -126,7 +133,7 @@ def run_zero_ab(stage: int, argv=None):
                              "scale": args.scale}) as telem_a:
         (_, base_opt_f), base_losses, base_dt = _time_steps(
             base_step, (params, base_opt), batch, cfg.num_steps, telem_a,
-            "baseline", tokens_per_step=cfg.batch_size)
+            "baseline", tokens_per_step=cfg.batch_size, cfg=cfg)
     base_opt_mb = tree_local_size_mb(base_opt_f.mu) + \
         tree_local_size_mb(base_opt_f.nu)
 
@@ -157,7 +164,7 @@ def run_zero_ab(stage: int, argv=None):
                              "rebuild": args.rebuild}) as telem_b:
         (shard_params_f, opt_f), shard_losses, shard_dt = _time_steps(
             step, state0, batch, cfg.num_steps, telem_b, name,
-            tokens_per_step=cfg.batch_size)
+            tokens_per_step=cfg.batch_size, cfg=cfg)
     shard_opt_mb = tree_local_size_mb(opt_f.mu) + tree_local_size_mb(opt_f.nu)
 
     # ---- comparison report (the reference's pass signal) -----------------

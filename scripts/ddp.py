@@ -59,8 +59,10 @@ def main(argv=None):
     import jax.numpy as jnp
     from distributed_training_sandbox_tpu.utils import (
         TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
-        PerformanceTracker, print_memory_stats, annotate)
+        PerformanceTracker, print_memory_stats)
     from distributed_training_sandbox_tpu.telemetry import TelemetryRun
+    from distributed_training_sandbox_tpu.runtime import (
+        DevicePrefetcher, StepPump)
     from distributed_training_sandbox_tpu.models import zero_toy_mlp
     from distributed_training_sandbox_tpu.models.mlp import mse_loss
     from distributed_training_sandbox_tpu.parallel import (
@@ -89,9 +91,10 @@ def main(argv=None):
     print(f"[ddp] param sync check passed (divergence {err})")
 
     opt_state = optim.sgd_init(params)
+    contract_name = "ddp_bucketed" if cfg.bucket_mb else "ddp"
     step = make_ddp_train_step(
         mse_loss, lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3),
-        mesh, "dp")
+        mesh, "dp", bucket_mb=cfg.bucket_mb)
 
     # batch: synthetic randn regression, global batch sharded over dp
     def make_batch(key):
@@ -100,36 +103,51 @@ def main(argv=None):
         y = jax.random.normal(ky, (cfg.batch_size, width))
         return x, y
 
+    def batch_stream(key):
+        while True:
+            key, bk = jax.random.split(key)
+            yield make_batch(bk)
+
     counts = count_collectives(step, params, opt_state, make_batch(key))
     n_params = len(jax.tree.leaves(params))
     print(f"[ddp] per-step collectives (HLO): {counts} "
-          f"(expect {n_params} grad all_reduces + loss mean + barrier)")
+          f"(expect {n_params} grad all_reduces + loss mean + barrier)"
+          if not cfg.bucket_mb else
+          f"[ddp] per-step collectives (HLO): {counts} "
+          f"(bucketed: ~{cfg.bucket_mb} MB flat grad buckets)")
     from distributed_training_sandbox_tpu.analysis import evaluate_contract
-    verdict = evaluate_contract("ddp", counts, params=params, mesh=mesh)
-    print(f"[ddp] contract[ddp]: {verdict.summary()}")
+    verdict = evaluate_contract(
+        contract_name, counts, params=params, mesh=mesh,
+        **({"bucket_mb": cfg.bucket_mb} if cfg.bucket_mb else {}))
+    print(f"[ddp] contract[{contract_name}]: {verdict.summary()}")
 
     tracker = PerformanceTracker(warmup_steps=min(5, cfg.num_steps - 1) if
                                  cfg.num_steps > 1 else 0)
     prof = Profiler(trace_dir=cfg.trace_dir,
                     schedule=ProfileSchedule(skip_first=5, wait=1, warmup=2,
                                              active=5)) if cfg.profile else None
-    metrics = None
+    # hot loop: prefetcher stages sharded batches in a background thread;
+    # the pump retires losses per the sync policy (no per-step host sync).
     # TelemetryRun owns the profiler: a crash mid-loop still flushes the
-    # in-flight trace and writes a status="crashed" summary
-    with TelemetryRun("ddp", config=cfg, mesh=mesh, model="mlp",
-                      collective_counts=counts,
-                      contract=verdict.to_dict(), profiler=prof) as telem:
-        for i in range(cfg.num_steps):
-            with annotate("data_movement"):
-                key, bk = jax.random.split(key)
-                batch = make_batch(bk)
-            params, opt_state, loss = step(params, opt_state, batch)
-            jax.block_until_ready(loss)  # step isolation (dist.barrier twin)
-            metrics = tracker.step(cfg.batch_size, loss=float(loss))
-            telem.step(loss=float(loss), tokens=cfg.batch_size,
-                       tracker_metrics=metrics)
-            if i % 5 == 0 or i == cfg.num_steps - 1:
-                print(f"[ddp] step {i:3d} loss {float(loss):.6f}")
+    # in-flight trace and writes a status="crashed" summary.
+    pref = DevicePrefetcher(batch_stream(key), mesh=mesh, spec=P("dp"),
+                            depth=cfg.prefetch_depth)
+    with pref, TelemetryRun("ddp", config=cfg, mesh=mesh, model="mlp",
+                            collective_counts=counts,
+                            contract=verdict.to_dict(),
+                            profiler=prof) as telem:
+        with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
+                      sync_every=cfg.sync_every,
+                      max_in_flight=cfg.max_in_flight) as pump:
+            for i, batch in zip(range(cfg.num_steps), pref):
+                params, opt_state, loss = step(params, opt_state, batch)
+                log = (lambda lf, i=i:
+                       print(f"[ddp] step {i:3d} loss {lf:.6f}")) \
+                    if i % 5 == 0 or i == cfg.num_steps - 1 else None
+                pump.emit(loss, tokens=cfg.batch_size, log=log)
+    metrics = pump.metrics
+    print(f"[ddp] host syncs: {pump.host_sync_count} "
+          f"({pump.sync_breakdown})")
 
     print_memory_stats("ddp-final", params=params, opt_state=opt_state)
     if metrics:
@@ -149,8 +167,10 @@ def classification_main(args, rest):
     import jax.numpy as jnp
     from distributed_training_sandbox_tpu.utils import (
         TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
-        PerformanceTracker, print_memory_stats, annotate)
+        PerformanceTracker, print_memory_stats)
     from distributed_training_sandbox_tpu.telemetry import TelemetryRun
+    from distributed_training_sandbox_tpu.runtime import (
+        DevicePrefetcher, StepPump)
     from distributed_training_sandbox_tpu.models import (
         transformer as T, init_classifier_params, classification_loss,
         classification_accuracy, MODEL_REGISTRY)
@@ -192,10 +212,11 @@ def classification_main(args, rest):
 
     opt_state = optim.sgd_init(params)
     loss_fn = functools.partial(classification_loss, cfg=mcfg)
+    contract_name = "ddp_bucketed" if cfg.bucket_mb else "ddp"
     step = make_ddp_train_step(
         lambda p, b: loss_fn(p, b),
         lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3),
-        mesh, "dp")
+        mesh, "dp", bucket_mb=cfg.bucket_mb)
 
     batches = classification_batches(
         examples, cfg.batch_size, ws, seed=cfg.seed,
@@ -209,36 +230,45 @@ def classification_main(args, rest):
     print(f"[ddp] per-step collectives (HLO): {counts} "
           f"(expect {n_leaves} grad all_reduces + loss mean + barrier)")
     from distributed_training_sandbox_tpu.analysis import evaluate_contract
-    verdict = evaluate_contract("ddp", counts, params=params, mesh=mesh)
-    print(f"[ddp] contract[ddp]: {verdict.summary()}")
+    verdict = evaluate_contract(
+        contract_name, counts, params=params, mesh=mesh,
+        **({"bucket_mb": cfg.bucket_mb} if cfg.bucket_mb else {}))
+    print(f"[ddp] contract[{contract_name}]: {verdict.summary()}")
 
     tracker = PerformanceTracker(warmup_steps=min(3, cfg.num_steps - 1) if
                                  cfg.num_steps > 1 else 0)
     prof = Profiler(trace_dir=cfg.trace_dir,
                     schedule=ProfileSchedule(skip_first=5, wait=1, warmup=2,
                                              active=5)) if cfg.profile else None
-    metrics = None
-    batch = first
-    with TelemetryRun("ddp", config=cfg, mesh=mesh, model=args.model,
-                      collective_counts=counts,
-                      contract=verdict.to_dict(), profiler=prof) as telem:
-        for i in range(cfg.num_steps):
-            with annotate("data_movement"):
-                jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
-            params, opt_state, loss = step(params, opt_state, jbatch)
-            jax.block_until_ready(loss)
-            metrics = tracker.step(int(jbatch["input_ids"].size),
-                                   loss=float(loss))
-            telem.step(loss=float(loss),
-                       tokens=int(jbatch["input_ids"].size),
-                       tracker_metrics=metrics)
-            if i % 5 == 0 or i == cfg.num_steps - 1:
-                print(f"[ddp] step {i:3d} loss {float(loss):.4f} "
-                      f"(padded width {jbatch['input_ids'].shape[1]})")
-            try:
-                batch = next(batches)
-            except StopIteration:
-                break
+    # batches enter committed under the step's dp sharding (device_put in
+    # the prefetcher thread), not a replicated/uncommitted jnp.asarray
+    import itertools
+    pref = DevicePrefetcher(itertools.chain([first], batches),
+                            mesh=mesh, spec=P("dp"),
+                            depth=cfg.prefetch_depth)
+    with pref, TelemetryRun("ddp", config=cfg, mesh=mesh, model=args.model,
+                            collective_counts=counts,
+                            contract=verdict.to_dict(),
+                            profiler=prof) as telem:
+        with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
+                      sync_every=cfg.sync_every,
+                      max_in_flight=cfg.max_in_flight) as pump:
+            for i, jbatch in zip(range(cfg.num_steps), pref):
+                if i == 0:
+                    sh = jbatch["input_ids"].sharding
+                    assert getattr(sh, "spec", None) == P("dp"), \
+                        f"batch not dp-sharded: {sh}"
+                params, opt_state, loss = step(params, opt_state, jbatch)
+                width = jbatch["input_ids"].shape[1]
+                log = (lambda lf, i=i, w=width:
+                       print(f"[ddp] step {i:3d} loss {lf:.4f} "
+                             f"(padded width {w})")) \
+                    if i % 5 == 0 or i == cfg.num_steps - 1 else None
+                pump.emit(loss, tokens=int(jbatch["input_ids"].size),
+                          log=log)
+    metrics = pump.metrics
+    print(f"[ddp] host syncs: {pump.host_sync_count} "
+          f"({pump.sync_breakdown})")
 
     acc_fn = jax.jit(lambda p, b: classification_accuracy(p, b, mcfg))
     acc = float(acc_fn(params, {k: jnp.asarray(v)

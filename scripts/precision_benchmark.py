@@ -96,7 +96,9 @@ def run_one(model: str, precision: str, seq_len: int, num_steps: int,
     metrics = None
     for i in range(num_steps):
         shards, opt, loss = step(shards, opt, batch)
-        jax.block_until_ready(loss)
+        # this A/B bench wants the blocking loop, not the pump's
+        # deferred retire: per-step latency IS the measurement
+        jax.block_until_ready(loss)  # sync-ok
         metrics = tracker.step(bs * seq_len, loss=float(loss))
         line = (f"step {i} loss {float(loss):.4f}")
         log_lines.append(line)

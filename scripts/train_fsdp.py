@@ -47,12 +47,15 @@ def main(argv=None):
 
     import jax
     import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
     from distributed_training_sandbox_tpu.utils import (
         TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
-        PerformanceTracker, print_memory_stats, annotate)
+        PerformanceTracker, print_memory_stats)
     from distributed_training_sandbox_tpu.utils.flops import (
         get_model_flops_per_token)
     from distributed_training_sandbox_tpu.telemetry import TelemetryRun
+    from distributed_training_sandbox_tpu.runtime import (
+        DevicePrefetcher, StepPump)
     from distributed_training_sandbox_tpu.models import transformer as T
     from distributed_training_sandbox_tpu.parallel import fsdp
     from distributed_training_sandbox_tpu.ops import count_collectives
@@ -129,26 +132,31 @@ def main(argv=None):
                                     n_layers=mcfg.num_hidden_layers)
         print(f"[fsdp] contract[fsdp]: {verdict.summary()}")
 
-    metrics = None
     tokens_per_step = cfg.batch_size * cfg.sequence_length
     batches = packed_batches(input_ids, labels, cfg.batch_size,
                              epochs=cfg.num_epochs * cfg.num_steps)
-    with TelemetryRun("fsdp", config=cfg, mesh=mesh, model=args.model,
-                      collective_counts=counts, profiler=prof,
-                      contract=verdict.to_dict() if verdict else None,
-                      extra={"variant": args.variant,
-                             "reshard_after_forward": args.reshard}) as telem:
-        for i in range(cfg.num_steps):
-            with annotate("data_movement"):
-                bi, bl = next(batches)
-                batch = (jnp.asarray(bi), jnp.asarray(bl))
-            shards, opt_state, loss = step(shards, opt_state, batch)
-            jax.block_until_ready(loss)
-            metrics = tracker.step(tokens_per_step, loss=float(loss))
-            telem.step(loss=float(loss), tokens=tokens_per_step,
-                       tracker_metrics=metrics)
-            if i % 5 == 0 or i == cfg.num_steps - 1:
-                print(f"[fsdp] step {i:3d} loss {float(loss):.4f}")
+    # prefetcher stages (ids, labels) committed under the step's dp batch
+    # sharding; pump retires losses per the sync policy
+    pref = DevicePrefetcher(batches, mesh=mesh, spec=P("dp"),
+                            depth=cfg.prefetch_depth)
+    with pref, TelemetryRun(
+            "fsdp", config=cfg, mesh=mesh, model=args.model,
+            collective_counts=counts, profiler=prof,
+            contract=verdict.to_dict() if verdict else None,
+            extra={"variant": args.variant,
+                   "reshard_after_forward": args.reshard}) as telem:
+        with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
+                      sync_every=cfg.sync_every,
+                      max_in_flight=cfg.max_in_flight) as pump:
+            for i, batch in zip(range(cfg.num_steps), pref):
+                shards, opt_state, loss = step(shards, opt_state, batch)
+                log = (lambda lf, i=i:
+                       print(f"[fsdp] step {i:3d} loss {lf:.4f}")) \
+                    if i % 5 == 0 or i == cfg.num_steps - 1 else None
+                pump.emit(loss, tokens=tokens_per_step, log=log)
+    metrics = pump.metrics
+    print(f"[fsdp] host syncs: {pump.host_sync_count} "
+          f"({pump.sync_breakdown})")
     if prof:
         from distributed_training_sandbox_tpu.utils.trace_analysis import (
             split_from_trace)

@@ -52,10 +52,13 @@ def main(argv=None):
     from distributed_training_sandbox_tpu.parallel import expert, fsdp
     from distributed_training_sandbox_tpu.utils import (
         PerformanceTracker, ProfileSchedule, Profiler, TrainConfig,
-        annotate, make_mesh, print_memory_stats, set_seed)
+        make_mesh, print_memory_stats, set_seed)
     from distributed_training_sandbox_tpu.utils.flops import (
         get_model_flops_per_token)
     from distributed_training_sandbox_tpu.telemetry import TelemetryRun
+    from distributed_training_sandbox_tpu.runtime import (
+        DevicePrefetcher, StepPump)
+    from jax.sharding import PartitionSpec as P
 
     cfg = TrainConfig.from_args(
         rest, sequence_length=256 if args.model == "tiny" else 8192)
@@ -124,27 +127,32 @@ def main(argv=None):
                     schedule=ProfileSchedule(skip_first=0, wait=1,
                                              warmup=2, active=5)) \
         if cfg.profile else None
-    metrics = None
     batches = packed_batches(input_ids, labels, cfg.batch_size,
                              epochs=cfg.num_epochs * cfg.num_steps)
-    with TelemetryRun("moe", config=cfg, mesh=mesh, model=args.model,
-                      collective_counts=counts, profiler=prof,
-                      contract=verdict.to_dict(),
-                      extra={"experts": args.experts, "ep": args.ep,
-                             "top_k": args.top_k}) as telem:
-        for i in range(cfg.num_steps):
-            with annotate("data_movement"):
-                bi, bl = next(batches)
-                batch = (jnp.asarray(bi), jnp.asarray(bl))
-            shards, opt_state, loss = step(shards, opt_state, batch)
-            jax.block_until_ready(loss)
-            metrics = tracker.step(cfg.batch_size * cfg.sequence_length,
-                                   loss=float(loss))
-            telem.step(loss=float(loss),
-                       tokens=cfg.batch_size * cfg.sequence_length,
-                       tracker_metrics=metrics)
-            if i % 5 == 0 or i == cfg.num_steps - 1:
-                print(f"[train_moe] step {i:3d} loss {float(loss):.4f}")
+    # batch dim is sharded over the flattened (dp, ep) axes in the moe
+    # step's in_spec — stage it that way from the prefetcher thread
+    pref = DevicePrefetcher(batches, mesh=mesh, spec=P(("dp", "ep")),
+                            depth=cfg.prefetch_depth)
+    with pref, TelemetryRun(
+            "moe", config=cfg, mesh=mesh, model=args.model,
+            collective_counts=counts, profiler=prof,
+            contract=verdict.to_dict(),
+            extra={"experts": args.experts, "ep": args.ep,
+                   "top_k": args.top_k}) as telem:
+        with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
+                      sync_every=cfg.sync_every,
+                      max_in_flight=cfg.max_in_flight) as pump:
+            for i, batch in zip(range(cfg.num_steps), pref):
+                shards, opt_state, loss = step(shards, opt_state, batch)
+                log = (lambda lf, i=i:
+                       print(f"[train_moe] step {i:3d} loss {lf:.4f}")) \
+                    if i % 5 == 0 or i == cfg.num_steps - 1 else None
+                pump.emit(loss,
+                          tokens=cfg.batch_size * cfg.sequence_length,
+                          log=log)
+    metrics = pump.metrics
+    print(f"[train_moe] host syncs: {pump.host_sync_count} "
+          f"({pump.sync_breakdown})")
     if prof:
         from distributed_training_sandbox_tpu.utils.trace_analysis import (
             split_from_trace)

@@ -103,6 +103,60 @@ train_step = jax.jit(loss)
     assert "step-jit-missing-donation" not in _checks(lint_source(other))
 
 
+def test_host_sync_in_loop_fires():
+    src = """
+import jax
+def train(step, state, batches):
+    for b in batches:
+        state, loss = step(state, b)
+        jax.block_until_ready(loss)
+        print(float(loss))
+"""
+    found = [x for x in lint_source(src) if x.check == "host-sync-in-loop"]
+    assert [(f.line, f.severity) for f in found] == \
+        [(6, "error"), (7, "warn")]
+
+
+def test_host_sync_local_scalar_fires():
+    src = """
+from distributed_training_sandbox_tpu.utils import local_scalar
+def run(step, s, b):
+    for i in range(10):
+        s, loss = step(s, b)
+        v = local_scalar(loss)
+"""
+    found = [x for x in lint_source(src) if x.check == "host-sync-in-loop"]
+    assert [f.severity for f in found] == ["error"]
+
+
+def test_sync_ok_pragma_suppresses():
+    src = """
+import jax
+def bench(step, s, b):
+    for i in range(10):
+        s, loss = step(s, b)
+        jax.block_until_ready(loss)  # sync-ok: latency benchmark
+"""
+    assert "host-sync-in-loop" not in _checks(lint_source(src))
+    # pragma on the line above also counts
+    src2 = src.replace(
+        "        jax.block_until_ready(loss)  # sync-ok: latency benchmark",
+        "        # sync-ok: latency benchmark\n"
+        "        jax.block_until_ready(loss)")
+    assert "host-sync-in-loop" not in _checks(lint_source(src2))
+
+
+def test_host_sync_outside_loop_or_in_jit_is_fine():
+    src = """
+import jax
+def once(step, s, b):
+    s, loss = step(s, b)
+    jax.block_until_ready(loss)
+    return float(loss)
+"""
+    assert "host-sync-in-loop" not in _checks(lint_source(src))
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     p = tmp_path / "broken.py"
     p.write_text("def f(:\n")
