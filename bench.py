@@ -243,6 +243,55 @@ def run_matrix(model_name: str, seq: int, base_batch: int):
     return rows
 
 
+def measure_checkpoint_overhead(model_name: str, seq: int, batch: int,
+                                num_steps: int = 3) -> dict:
+    """Checkpoint save/restore overhead at the bench payload shape: the
+    resilience runtime's cost row.  Times a full RunState save (params +
+    AdamW state, Orbax parallel shard writes, wait=True so the number is
+    the worst-case blocking cost), an async save's *blocking* portion
+    (the device->host copy — what a train step actually waits on), and
+    the restore.  Amortize with --checkpoint-every: overhead/step =
+    save_ms / N."""
+    import tempfile
+    import jax
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.parallel import fsdp
+    from distributed_training_sandbox_tpu.resilience import (
+        Checkpointer, RunState)
+    from distributed_training_sandbox_tpu.utils import (
+        make_mesh, tree_size_mb)
+
+    cfg = getattr(T, model_name)
+    mesh = make_mesh()
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    shards = fsdp.shard_params_fsdp(params, mesh)
+    del params
+    opt = fsdp.init_fsdp_opt_state(shards)
+    state_mb = tree_size_mb(shards) + tree_size_mb(opt.mu) \
+        + tree_size_mb(opt.nu)
+    with tempfile.TemporaryDirectory(prefix="bench-ckpt-") as d:
+        ck = Checkpointer(d, every=1)
+        jax.block_until_ready(shards)
+        t0 = time.perf_counter()
+        ck.save(RunState(params=shards, opt_state=opt, step=0), wait=True)
+        save_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        ck.save(RunState(params=shards, opt_state=opt, step=1), wait=False)
+        async_blocking_ms = (time.perf_counter() - t0) * 1e3
+        ck.close()
+        t0 = time.perf_counter()
+        rs = ck.restore_latest(RunState(params=shards, opt_state=opt))
+        jax.block_until_ready(rs.params)
+        restore_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "model": model_name, "seq_len": seq, "batch": batch,
+        "state_mb": round(state_mb, 1),
+        "save_wait_ms": round(save_ms, 1),
+        "save_async_blocking_ms": round(async_blocking_ms, 1),
+        "restore_ms": round(restore_ms, 1),
+    }
+
+
 def reference_tflops_per_device() -> float:
     from distributed_training_sandbox_tpu.models import transformer as T
     from distributed_training_sandbox_tpu.utils.flops import (
@@ -285,6 +334,13 @@ def main():
         return
     best = max(good, key=lambda r: r["tflops_per_device"])
     ref = reference_tflops_per_device()
+    try:
+        # model/seq/bs still bound to the tier the matrix measured
+        ckpt_row = measure_checkpoint_overhead(model, seq, bs)
+    except Exception as e:  # noqa: BLE001 - the bench line must print
+        ckpt_row = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+    print(f"[bench] checkpoint_overhead {ckpt_row}", file=sys.stderr,
+          flush=True)
     by_cfg = {r["config"]: r for r in good}
     pump_ab = None
     if {"explicit_reshard", "explicit_reshard_syncstep"} <= set(by_cfg):
@@ -302,6 +358,7 @@ def main():
         "baseline": f"reference FSDP2 SmolLM3-3B seq8192 2xA100 "
                     f"{REF_TOK_S:.0f} tok/s = {ref:.1f} TFLOPS/device",
         "pump_ab": pump_ab,
+        "checkpoint_overhead": ckpt_row,
         "matrix": matrix,
     }
     print(json.dumps(out))

@@ -27,6 +27,15 @@ traces it), tuned so the current ``scripts/`` tree is clean at the
     declared sync policy points.  Loops that sync *deliberately*
     (latency benchmarks, warmup fences) mark the line — or the line
     above it — with a ``sync-ok`` comment to suppress the finding.
+  * ``ckpt-manager-no-wait`` (error) — the file opens an Orbax manager
+    (``checkpoint_manager(...)`` / ``CheckpointManager(...)``) but never
+    guarantees ``wait_until_finished`` on exit: no direct call, no
+    ``utils.checkpoint.closing(...)`` wrapper, no ``resilience``
+    ``Checkpointer``/``Supervisor`` (both close in a finally).  An async
+    ``save_state(..., wait=False)`` then races process exit and can
+    leave a torn newest step.  A deliberate open (restore-only paths
+    that never save) marks the call line — or the line above — with a
+    ``ckpt-ok`` comment.
 
 Findings carry a severity; ``scripts/lint_sharding.py`` fails the run
 only on errors (``--strict`` promotes warnings).
@@ -58,6 +67,11 @@ SHARD_WRAPPERS = {"shard_map", "smap", "pmap", "shmap", "xmap"}
 # per-step host synchronization calls — the pattern the runtime step
 # pump's sync policy replaces in driver hot loops
 HOST_SYNC_FNS = {"block_until_ready", "local_scalar"}
+# opening an Orbax manager; and the names whose presence anywhere in the
+# file counts as a guaranteed wait_until_finished-on-exit
+CKPT_OPENERS = {"checkpoint_manager", "CheckpointManager"}
+CKPT_GUARDS = {"wait_until_finished", "closing", "Checkpointer",
+               "Supervisor"}
 
 SEV_ERROR = "error"
 SEV_WARN = "warn"
@@ -112,6 +126,8 @@ class _Visitor(ast.NodeVisitor):
         self._jit_depth = 0
         self.uses_shard_wrapper = False
         self.collective_calls: list[tuple[int, str]] = []
+        self.ckpt_opens: list[tuple[int, str]] = []
+        self.has_ckpt_guard = False
 
     # -- context tracking -------------------------------------------------
     def _visit_function(self, node):
@@ -151,6 +167,10 @@ class _Visitor(ast.NodeVisitor):
         if (leaf in COLLECTIVE_FNS
                 and root in ("lax", "jax", "C", "collectives")):
             self.collective_calls.append((node.lineno, chain))
+        if leaf in CKPT_OPENERS:
+            self.ckpt_opens.append((node.lineno, chain))
+        if leaf in CKPT_GUARDS:
+            self.has_ckpt_guard = True
         if self._loop_depth and not self._jit_depth:
             self._check_host_sync(node, chain, leaf, root)
         if _is_jit_call(node):
@@ -182,10 +202,14 @@ class _Visitor(ast.NodeVisitor):
     def visit_Name(self, node: ast.Name):
         if node.id in SHARD_WRAPPERS:
             self.uses_shard_wrapper = True
+        if node.id in CKPT_GUARDS:
+            self.has_ckpt_guard = True
 
     def visit_Attribute(self, node: ast.Attribute):
         if node.attr in SHARD_WRAPPERS:
             self.uses_shard_wrapper = True
+        if node.attr in CKPT_GUARDS:
+            self.has_ckpt_guard = True
         self.generic_visit(node)
 
     def _check_donation(self, node: ast.Call):
@@ -220,17 +244,30 @@ def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
     _annotate_assignments(tree)
     v = _Visitor(path)
     v.visit(tree)
-    # 'sync-ok' pragma: a deliberate per-iteration sync (latency bench,
-    # warmup fence) on the flagged line or the line above suppresses the
-    # host-sync-in-loop finding — nothing else
+    # pragmas: a marker on the flagged line or the line above suppresses
+    # exactly its check — 'sync-ok' for deliberate per-iteration syncs
+    # (latency bench, warmup fence), 'ckpt-ok' for deliberate unguarded
+    # manager opens (restore-only paths) — nothing else
     lines = src.splitlines()
-    def _sync_ok(line_no: int) -> bool:
-        return any("sync-ok" in lines[i]
+    def _pragma(line_no: int, marker: str) -> bool:
+        return any(marker in lines[i]
                    for i in (line_no - 1, line_no - 2)
                    if 0 <= i < len(lines))
     findings = [f for f in v.findings
                 if not (f.check == "host-sync-in-loop"
-                        and _sync_ok(f.line))]
+                        and _pragma(f.line, "sync-ok"))]
+    if v.ckpt_opens and not v.has_ckpt_guard:
+        for line, chain in v.ckpt_opens:
+            if _pragma(line, "ckpt-ok"):
+                continue
+            findings.append(PitfallFinding(
+                path, line, "ckpt-manager-no-wait", SEV_ERROR,
+                f"{chain}() opened but the file never guarantees "
+                f"wait_until_finished() on exit — an async save racing "
+                f"process exit can leave a torn newest step; wrap the "
+                f"manager in utils.checkpoint.closing(...) (or use "
+                f"resilience.Checkpointer), or mark a restore-only "
+                f"open with '# ckpt-ok'"))
     if v.collective_calls and not v.uses_shard_wrapper:
         line, chain = v.collective_calls[0]
         findings.append(PitfallFinding(

@@ -668,14 +668,23 @@ def train_pipeline(stages: list[PipelineStage], schedule: str,
                    make_batch: Callable[[int], tuple],
                    num_epochs: int, n_micro: int = 4,
                    lr: float | Callable[[int], float] = 1e-3,
-                   log: Callable | None = None) -> PipeResult:
+                   log: Callable | None = None,
+                   start_epoch: int = 0,
+                   should_stop: Callable[[int], bool] | None = None
+                   ) -> PipeResult:
     """Epoch loop + metrics, twin of the reference's ``__main__`` epoch loop
     and JSON dump (``1f1b.py:186-205``, ``gpipe.py:205-218``).
 
     ``lr`` may be a schedule ``epoch -> lr`` — large-vocab models need
     warmup here exactly as the flagship loop does (an lr=1e-3 cold Adam
     start on a 1B-param model spikes the loss for the whole short run;
-    that, not a staging bug, was the r4 rising-loss artifact)."""
+    that, not a staging bug, was the r4 rising-loss artifact).
+
+    ``start_epoch``/``should_stop`` are the resilience driver's resume/
+    preemption hooks: epochs before ``start_epoch`` were replayed from a
+    checkpoint (``make_batch``/``lr`` still see absolute epoch indices);
+    ``should_stop(epoch)`` is polled before each epoch so a preemption
+    notice exits the schedule between epochs, never mid-microbatch."""
     sched_stats: dict = {}
     if schedule == "interleaved":
         def run(stages, x, y, n_micro, lr):
@@ -686,13 +695,16 @@ def train_pipeline(stages: list[PipelineStage], schedule: str,
     lr_fn = lr if callable(lr) else (lambda _e: lr)
     losses = []
     t0 = time.perf_counter()
-    for epoch in range(num_epochs):
+    for epoch in range(start_epoch, num_epochs):
+        if should_stop is not None and should_stop(epoch):
+            break
         x, y = make_batch(epoch)
         loss = run(stages, x, y, n_micro=n_micro, lr=lr_fn(epoch))
         losses.append(loss)
         if log:
             log(epoch, loss)
     total = time.perf_counter() - t0
+    n_run = max(len(losses), 1)
     peaks = {f"device_{i}": s.peak_memory_mb() for i, s in enumerate(stages)}
     plan = {f"device_{i}": round(s.memory_plan_mb(), 1)
             for i, s in enumerate(stages)}
@@ -706,12 +718,12 @@ def train_pipeline(stages: list[PipelineStage], schedule: str,
         schedule=schedule,
         n_stages=len(stages),
         n_micro=n_micro,
-        final_loss=losses[-1],
-        avg_loss=sum(losses) / len(losses),
+        final_loss=losses[-1] if losses else float("nan"),
+        avg_loss=sum(losses) / n_run if losses else float("nan"),
         losses=[round(float(l), 6) for l in losses],
         total_time_s=total,
-        avg_epoch_time_s=total / num_epochs,
-        epochs_per_s=num_epochs / total,
+        avg_epoch_time_s=total / n_run,
+        epochs_per_s=n_run / total if total else 0.0,
         peak_memory_mb=peaks,
         total_peak_memory_mb=sum(peaks.values()),
         memory_source=("allocator" if any(peaks.values())

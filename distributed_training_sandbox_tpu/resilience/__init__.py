@@ -1,0 +1,50 @@
+"""Run-level fault tolerance: preemption-safe full-run resume.
+
+The reference (and this repo through PR 3) treats every interruption as
+fatal: only ``scripts/train_flagship.py`` saved anything, and only the
+final params — no optimizer state, no PRNG root, no data position, so an
+interrupted run restarted from scratch and the bit-exact trajectories
+the async pump pinned were unverifiable across a restart.  This package
+is the missing run-level half, layered over the existing Orbax wrapper
+(``utils/checkpoint.py``), StepPump, and telemetry:
+
+  * :mod:`state` — :class:`RunState`, the strategy-agnostic snapshot of
+    everything a resume needs (params, opt state, root PRNG key, host
+    data cursor, step index, loss log, restart lineage), saved
+    asynchronously at StepPump sync points by :class:`Checkpointer` so
+    checkpointing rides the existing host-sync schedule;
+  * :mod:`supervisor` — the in-process restart loop: a SIGTERM handler
+    that drains the pump, flushes telemetry, takes a final checkpoint
+    and exits cleanly; ``--max-restarts`` with backoff resumes from the
+    latest step and records restart lineage in ``manifest.json``;
+  * :mod:`faults` — deterministic fault injection (crash-at-step-N,
+    simulated preemption, truncated/corrupted checkpoint files) behind
+    the ``--inject-fault`` debug flag and the test suite.
+
+The headline guarantee, pinned by ``tests/test_resilience.py`` on the
+8-way CPU mesh: preempt a run at step k, resume it, and the concatenated
+loss sequence is bitwise-identical to the uninterrupted run — including
+the host data cursor and PRNG position.
+"""
+
+from .state import (  # noqa: F401
+    CheckpointCorruptError,
+    Checkpointer,
+    RunState,
+    restore_run_state,
+    save_run_state,
+)
+from .faults import (  # noqa: F401
+    FaultInjector,
+    FaultSpec,
+    InjectedCrash,
+    corrupt_checkpoint,
+    parse_fault_spec,
+    truncate_checkpoint,
+)
+from .supervisor import (  # noqa: F401
+    GracefulShutdown,
+    Preempted,
+    ResilienceContext,
+    Supervisor,
+)

@@ -1,0 +1,141 @@
+"""Deterministic fault injection for the resilience runtime.
+
+Real failure modes on preemptible TPU fleets — hard crashes, SIGTERM
+preemption notices, torn checkpoint writes — are nondeterministic by
+nature, which makes "survives preemption" untestable unless the faults
+themselves become deterministic.  This module is that harness:
+
+  * ``--inject-fault crash@N`` — raise :class:`InjectedCrash` when the
+    loop is about to execute step N (no final checkpoint: the recovery
+    path must come from the last *periodic* save);
+  * ``--inject-fault preempt@N`` — deliver a real ``SIGTERM`` to this
+    process at step N, exercising the supervisor's graceful-shutdown
+    path (drain pump, flush telemetry, final checkpoint, clean exit);
+  * ``crash@N:label`` / ``preempt@N:label`` — scope the fault to one
+    named leg of a multi-leg driver (the zero A/B scripts' ``baseline``
+    / ``sharded`` legs);
+  * :func:`truncate_checkpoint` / :func:`corrupt_checkpoint` — tamper
+    with a saved step's files on disk, for pinning that a torn restore
+    fails with a readable error instead of a tensorstore traceback.
+
+Faults fire exactly once per process (the injector is shared across
+in-process restart attempts), so a resumed segment runs to completion.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import signal
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+FAULT_KINDS = ("crash", "preempt")
+_SPEC_RE = re.compile(r"^(?P<kind>[a-z]+)@(?P<step>\d+)(?::(?P<target>[\w-]+))?$")
+
+
+class InjectedCrash(RuntimeError):
+    """The simulated hard failure — semantically a power cut: no
+    graceful path runs, no final checkpoint is taken."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    kind: str            # "crash" | "preempt"
+    step: int            # loop index at which the fault fires
+    target: str = ""     # scope label ("" = any leg)
+
+    def __str__(self) -> str:
+        base = f"{self.kind}@{self.step}"
+        return f"{base}:{self.target}" if self.target else base
+
+
+def parse_fault_spec(spec: str | None) -> FaultSpec | None:
+    """``"crash@5"`` / ``"preempt@8:sharded"`` -> FaultSpec; None/""
+    -> None.  Bad specs fail loudly (a typo'd fault flag that silently
+    never fires would make a passing resilience test meaningless)."""
+    if not spec:
+        return None
+    m = _SPEC_RE.match(spec.strip())
+    if not m or m.group("kind") not in FAULT_KINDS:
+        raise SystemExit(
+            f"--inject-fault {spec!r} not understood: expected "
+            f"KIND@STEP[:leg] with KIND in {'/'.join(FAULT_KINDS)} "
+            f"(e.g. crash@5, preempt@8:sharded)")
+    return FaultSpec(kind=m.group("kind"), step=int(m.group("step")),
+                     target=m.group("target") or "")
+
+
+class FaultInjector:
+    """One-shot trigger checked at the top of every loop iteration."""
+
+    def __init__(self, spec: FaultSpec | None):
+        self.spec = spec
+        self.fired = False
+
+    def check(self, step: int, shutdown=None, scope: str = "") -> None:
+        """Fire the configured fault if ``step``/``scope`` match.
+        ``crash`` raises; ``preempt`` delivers SIGTERM to this process
+        and returns once the handler has observed it (deterministic for
+        the caller's next ``shutdown.requested`` check)."""
+        if self.fired or self.spec is None or step != self.spec.step:
+            return
+        if self.spec.target and self.spec.target != scope:
+            return
+        self.fired = True
+        if self.spec.kind == "crash":
+            raise InjectedCrash(
+                f"injected crash at step {step}"
+                + (f" ({scope})" if scope else ""))
+        os.kill(os.getpid(), signal.SIGTERM)
+        # CPython runs the handler between bytecodes; wait until the
+        # flag is visible so the caller's very next check sees it
+        deadline = time.monotonic() + 2.0
+        while shutdown is not None and not shutdown.requested \
+                and time.monotonic() < deadline:
+            time.sleep(0.001)
+
+
+# ---- checkpoint tampering (tests + manual debugging) ---------------------
+
+def _step_files(directory, step: int | None) -> list[Path]:
+    root = Path(directory)
+    if step is None:
+        step_dirs = sorted((d for d in root.iterdir()
+                            if d.is_dir() and d.name.isdigit()),
+                           key=lambda d: int(d.name))
+        if not step_dirs:
+            raise FileNotFoundError(f"no checkpoint step dirs in {root}")
+        root = step_dirs[-1]
+    else:
+        root = root / str(step)
+    files = [p for p in root.rglob("*") if p.is_file()]
+    if not files:
+        raise FileNotFoundError(f"no files under checkpoint step {root}")
+    return files
+
+
+def truncate_checkpoint(directory, step: int | None = None,
+                        *, keep_bytes: int = 8) -> list[Path]:
+    """Truncate every payload file of a saved step — the torn-write
+    shape a preemption mid-flush leaves behind (a tiny array's bytes
+    can hide in more than one tensorstore file, so tearing just the
+    largest file may leave a restorable copy).  Returns the mangled
+    paths."""
+    files = _step_files(directory, step)
+    for p in files:
+        with open(p, "r+b") as f:
+            f.truncate(min(keep_bytes, p.stat().st_size))
+    return files
+
+
+def corrupt_checkpoint(directory, step: int | None = None) -> list[Path]:
+    """Overwrite the head of every file in a saved step with garbage —
+    the bit-rot/partial-overwrite shape.  Returns the mangled paths."""
+    files = _step_files(directory, step)
+    for p in files:
+        size = p.stat().st_size
+        with open(p, "r+b") as f:
+            f.write(b"\xde\xad\xbe\xef" * max(1, min(size, 64) // 4))
+    return files

@@ -114,10 +114,15 @@ class StepPump:
 
     # ---- the per-step call ----------------------------------------------
     def emit(self, loss, *, tokens: int | None = None, log=None,
-             **extra) -> None:
+             **extra) -> bool:
         """Record one dispatched step whose loss is ``loss`` (a device
         array).  ``log``, if given, is called with the resolved float at
-        sync time — drivers put their console prints there."""
+        sync time — drivers put their console prints there.
+
+        Returns True when this step was a full sync point (everything
+        up to and including this loss resolved) — the signal the
+        resilience checkpointer rides so async saves land on the
+        existing host-sync schedule instead of adding barriers."""
         if self._closed:
             raise RuntimeError("emit() after close()")
         import jax
@@ -140,6 +145,7 @@ class StepPump:
             if self.telem is not None:
                 self.telem.step(loss=lf, tokens=tokens,
                                 tracker_metrics=metrics, **extra)
+            return True
         else:
             self._pending.append((i, loss, log))
             if self.telem is not None:
@@ -154,6 +160,7 @@ class StepPump:
                 if self.telem is not None:
                     self.telem.flush(up_to=1)
                 self._count("throttle")
+            return False
 
     # ---- lifecycle -------------------------------------------------------
     def close(self) -> None:

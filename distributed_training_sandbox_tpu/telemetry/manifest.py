@@ -64,6 +64,11 @@ class RunManifest:
     started_utc: str = ""
     collective_counts: dict | None = None
     contract: dict | None = None
+    # restart lineage (resilience.supervisor): attempt index, restart
+    # budget, resumed_from_step, the resume contract re-check, and the
+    # prior segments' {run_id, start/end_step, status} records —
+    # scripts/report.py stitches these into one segmented-run view
+    lineage: dict | None = None
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -71,6 +76,7 @@ class RunManifest:
                 config: Any = None, mesh=None, model: str | None = None,
                 collective_counts: dict | None = None,
                 contract: dict | None = None,
+                lineage: dict | None = None,
                 extra: dict | None = None) -> "RunManifest":
         """Snapshot the environment at step 0.  ``mesh`` is a
         ``jax.sharding.Mesh`` (or None for meshless scripts);
@@ -106,6 +112,7 @@ class RunManifest:
                 datetime.timezone.utc).isoformat(timespec="seconds"),
             collective_counts=collective_counts,
             contract=contract,
+            lineage=dict(lineage) if lineage else None,
             extra=dict(extra or {}),
         )
 

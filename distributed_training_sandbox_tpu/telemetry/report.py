@@ -119,6 +119,10 @@ def run_row(rec: dict) -> dict:
         # choreography-contract verdict (analysis.evaluate_contract),
         # recorded by the strategy scripts since manifests grew the field
         "contract_ok": (man.get("contract") or {}).get("ok"),
+        # restart lineage (resilience.supervisor): present only on runs
+        # that ran under an active supervisor — rendered as stitched
+        # segments below the main table
+        "lineage": man.get("lineage"),
     }
     for k in ("step_time_ms", "tokens_per_second", "tflops_per_device",
               "avg_loss", "final_loss", "peak_memory_gb"):
@@ -229,6 +233,50 @@ def render_table(rows: list[dict]) -> str:
             f"| {_fmt(r.get('host_sync_count'), 'd')} "
             f"| {cc_cell} | {r.get('status', '—')} |")
     return "\n".join(out)
+
+
+# ---------------------------------------------------------------- lineage
+
+def _fmt_segment(seg: dict) -> str:
+    span = f"{seg.get('start_step', '?')}..{seg.get('end_step', '?')}"
+    scope = f"{seg['scope']}:" if seg.get("scope") else ""
+    return f"[{scope}{span} {seg.get('status', '?')}]"
+
+
+def render_lineage(rows: list[dict]) -> str:
+    """Stitched-segment view of every run whose manifest carries restart
+    lineage: the prior segments' spans/status chained into this run,
+    plus where it resumed and whether the collective contract re-check
+    passed on restore."""
+    out = []
+    for r in rows:
+        lin = r.get("lineage") or {}
+        if not lin:
+            continue
+        segs = [s for s in (lin.get("segments") or [])
+                if isinstance(s, dict)]
+        chain = " → ".join(_fmt_segment(s) for s in segs) if segs else ""
+        scopes = [("", lin)] + sorted((lin.get("scopes") or {}).items())
+        resumed = []
+        for label, sc in scopes:
+            if not isinstance(sc, dict) or sc.get("resumed_from_step") \
+                    is None:
+                continue
+            rc = sc.get("resume_contract") or {}
+            mark = " contract ✓" if rc.get("ok") is True \
+                else " contract ✗" if rc.get("ok") is False else ""
+            resumed.append(
+                f"{label + ' ' if label else ''}resumed from step "
+                f"{sc['resumed_from_step']}{mark}")
+        line = (f"- **{r.get('run_id', '?')}** "
+                f"(attempt {lin.get('attempt', 0)}"
+                f"/{lin.get('max_restarts', 0)} restarts)")
+        if resumed:
+            line += ": " + "; ".join(resumed)
+        if chain:
+            line += f"\n  - segments: {chain} → this run"
+        out.append(line)
+    return "\n".join(out) if out else "_no runs with restart lineage_"
 
 
 # ------------------------------------------------------------ regressions

@@ -43,6 +43,7 @@ class TelemetryRun:
                  model: str | None = None,
                  collective_counts: dict | None = None,
                  contract: dict | None = None,
+                 lineage: dict | None = None,
                  extra: dict | None = None,
                  results_dir: str | None = None,
                  run_name: str | None = None,
@@ -54,6 +55,7 @@ class TelemetryRun:
         self.model = model
         self.collective_counts = collective_counts
         self.contract = contract
+        self.lineage = lineage
         self.extra = extra
         self.profiler = profiler
         if results_dir is None:
@@ -106,6 +108,7 @@ class TelemetryRun:
                 mesh=self.mesh, model=self.model,
                 collective_counts=self.collective_counts,
                 contract=self.contract,
+                lineage=self.lineage,
                 extra=self.extra)
             self.writer = MetricsWriter(self.run_dir)
             self.writer.write_manifest(self.manifest)

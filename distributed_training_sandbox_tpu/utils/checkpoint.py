@@ -22,6 +22,7 @@ suite pins.
 
 from __future__ import annotations
 
+import contextlib
 import importlib
 import os
 from typing import Any
@@ -55,6 +56,24 @@ def save_state(mgr: "ocp.CheckpointManager", step: int, state: Any,
         mgr.wait_until_finished()
 
 
+@contextlib.contextmanager
+def closing(mgr: "ocp.CheckpointManager"):
+    """Guarantee ``wait_until_finished()`` on EVERY exit path — the
+    async-save safety contract.  ``save_state(..., wait=False)`` lets the
+    disk write overlap the next train steps, but a crash (or plain
+    return) before the write commits would leave a torn newest step;
+    wrapping the manager's lifetime in ``closing`` makes that impossible:
+
+        with closing(checkpoint_manager(dir)) as mgr:
+            save_state(mgr, step, state, wait=False)
+            ...                     # crash here still waits the write out
+    """
+    try:
+        yield mgr
+    finally:
+        mgr.wait_until_finished()
+
+
 def latest_step(mgr: "ocp.CheckpointManager") -> int | None:
     return mgr.latest_step()
 
@@ -76,15 +95,17 @@ def restore_state(mgr: "ocp.CheckpointManager", *, like: Any,
     return mgr.restore(step, args=ocp.args.StandardRestore(abstract))
 
 
-def restore_params(ckpt_dir, params):
-    """Convenience for the eval/demo scripts: open ``ckpt_dir``, restore
-    the newest step's ``{"params": ...}`` into ``params``' structure and
-    shardings, and return ``(restored_params, step)``.  Raises
-    SystemExit with a readable message when the directory holds no
-    steps (the CLI-facing contract both scripts share)."""
+def restore_params(ckpt_dir, params, *, tag: str = "restore"):
+    """THE restore-and-report path the eval/demo scripts share: open
+    ``ckpt_dir``, restore the newest step's ``{"params": ...}`` into
+    ``params``' structure and shardings, print the one-line
+    "restored step N from DIR" contract under ``tag``'s prefix, and
+    return ``(restored_params, step)``.  Raises SystemExit with a
+    readable message when the directory holds no steps."""
     mgr = checkpoint_manager(ckpt_dir)
     step = latest_step(mgr)
     if step is None:
         raise SystemExit(f"no checkpoint steps in {ckpt_dir}")
     state = restore_state(mgr, like={"params": params})
+    print(f"[{tag}] restored step {step} from {ckpt_dir}")
     return state["params"], step

@@ -54,6 +54,15 @@ class TrainConfig:
     sync_every: int = 10         # barrier every N steps (0 = exit only)
     max_in_flight: int = 16      # bounded dispatch window (backpressure)
     bucket_mb: float | None = None  # ddp: all-reduce grads in ~N MB buckets
+    # --- resilience runtime (resilience/) --------------------------------
+    # checkpoint_dir: RunState checkpoints (params + opt + PRNG root +
+    # data cursor + loss log) land here; checkpoint_every=N saves async
+    # at the pump's next sync point every N steps (0 = final state only).
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0
+    resume: bool = False         # restore the latest step before the loop
+    max_restarts: int = 0        # in-process restart budget after a fault
+    inject_fault: str | None = None  # debug: "crash@N" / "preempt@N[:leg]"
 
     @classmethod
     def from_args(cls, argv=None, **overrides) -> "TrainConfig":
@@ -129,4 +138,24 @@ def build_argparser(parser: argparse.ArgumentParser | None = None):
                    help="ddp: flatten per-dtype gradient leaves into "
                         "~N MB flat buckets before the all-reduce "
                         "(torch-DDP style; default: per-leaf)")
+    p.add_argument("--checkpoint-dir", dest="checkpoint_dir", type=str,
+                   default=None,
+                   help="save full RunState (params+opt+PRNG+data cursor) "
+                        "checkpoints here; enables --resume")
+    p.add_argument("--checkpoint-every", dest="checkpoint_every", type=int,
+                   default=None,
+                   help="async RunState save every N steps, written at "
+                        "the pump's next sync point (0 = final only)")
+    p.add_argument("--resume", dest="resume", action="store_true",
+                   default=None,
+                   help="resume from the latest step in --checkpoint-dir "
+                        "(bitwise-exact: data cursor + PRNG included)")
+    p.add_argument("--max-restarts", dest="max_restarts", type=int,
+                   default=None,
+                   help="in-process restart budget: resume from the "
+                        "latest checkpoint after a crash/preemption")
+    p.add_argument("--inject-fault", dest="inject_fault", type=str,
+                   default=None,
+                   help="debug fault injection: crash@N or "
+                        "preempt@N[:leg] (deterministic, fires once)")
     return p

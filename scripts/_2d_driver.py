@@ -3,7 +3,10 @@
 Same shape as the other L3 drivers (``_zero_driver``, ``train_fsdp``):
 model from config, packed dataset with offline fallback, warmup-aware
 tracker, optional profiler with the comm/compute split, HLO collective
-counts printed up front so the choreography is visible without a trace.
+counts printed up front so the choreography is visible without a trace,
+and the resilience supervisor wrapping the leg (``--checkpoint-dir/
+--checkpoint-every/--resume/--max-restarts`` — the 2-D shardings round
+trip through Orbax with their mesh layout intact).
 
 The reference has no 2-D strategies at all — these scripts are the
 runnable surface of the build's extensions (SURVEY.md §2.2 marks TP/SP
@@ -36,6 +39,20 @@ def run(mode: str, argv=None):
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(args.cpu_devices)
 
+    from distributed_training_sandbox_tpu.utils import TrainConfig
+    from distributed_training_sandbox_tpu import resilience as RZ
+
+    cfg = TrainConfig.from_args(
+        rest, sequence_length=256 if args.model == "tiny" else 8192)
+    sup = RZ.Supervisor.from_config(
+        cfg, strategy=f"train_{mode}",
+        extra_fingerprint={"model": args.model, mode: args.second})
+    return sup.run(lambda ctx: _leg(mode, args, rest, cfg, ctx))
+
+
+def _leg(mode, args, rest, cfg, ctx):
+    import itertools
+
     import jax
     import jax.numpy as jnp
     from distributed_training_sandbox_tpu.data import (
@@ -45,17 +62,16 @@ def run(mode: str, argv=None):
     from distributed_training_sandbox_tpu.parallel import (
         fsdp, sequence, tensor)
     from distributed_training_sandbox_tpu.utils import (
-        PerformanceTracker, ProfileSchedule, Profiler, TrainConfig,
+        PerformanceTracker, ProfileSchedule, Profiler,
         make_mesh, print_memory_stats, set_seed)
     from distributed_training_sandbox_tpu.utils.flops import (
         get_model_flops_per_token)
     from distributed_training_sandbox_tpu.telemetry import TelemetryRun
     from distributed_training_sandbox_tpu.runtime import (
         DevicePrefetcher, StepPump)
+    from distributed_training_sandbox_tpu import resilience as RZ
     from jax.sharding import PartitionSpec as P
 
-    cfg = TrainConfig.from_args(
-        rest, sequence_length=256 if args.model == "tiny" else 8192)
     mcfg: T.TransformerConfig = getattr(T, MODELS[args.model])
     n_dev = len(jax.devices())
     second = args.second
@@ -93,6 +109,10 @@ def run(mode: str, argv=None):
     opt_state = fsdp.init_fsdp_opt_state(shards)
     print_memory_stats(f"{name}-at-rest", params=shards,
                        opt_state=opt_state)
+    rs = ctx.restore(like=RZ.RunState(params=shards, opt_state=opt_state,
+                                      prng_key=key))
+    if rs is not None:
+        shards, opt_state = rs.params, rs.opt_state
 
     input_ids, labels = make_packed_dataset(
         cfg.sequence_length, mcfg.vocab_size,
@@ -107,6 +127,7 @@ def run(mode: str, argv=None):
     verdict = evaluate_contract(mode, counts, params=shards, mesh=mesh,
                                 n_layers=mcfg.num_hidden_layers)
     print(f"[{name}] contract[{mode}]: {verdict.summary()}")
+    ctx.verify_contract(verdict)
 
     flops_tok = get_model_flops_per_token(mcfg, cfg.sequence_length)
     tracker = PerformanceTracker(
@@ -119,6 +140,8 @@ def run(mode: str, argv=None):
 
     batches = packed_batches(input_ids, labels, cfg.batch_size,
                              epochs=cfg.num_epochs * cfg.num_steps)
+    if ctx.data_cursor:
+        batches = itertools.islice(batches, ctx.data_cursor, None)
     # sp mode shards (B, S) over both mesh axes; tp only over dp — stage
     # each batch under the step's own in_spec from the prefetcher thread
     batch_spec = P("dp", "sp") if mode == "sp" else P("dp")
@@ -127,19 +150,28 @@ def run(mode: str, argv=None):
     with pref, TelemetryRun(
             name, config=cfg, mesh=mesh, model=args.model,
             collective_counts=counts, profiler=prof,
-            contract=verdict.to_dict(), extra={mode: second}) as telem:
+            contract=verdict.to_dict(),
+            lineage=ctx.manifest_lineage(),
+            extra={mode: second}) as telem:
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight) as pump:
-            for i, batch in zip(range(cfg.num_steps), pref):
+            for i, batch in zip(range(ctx.start_step, cfg.num_steps), pref):
+                if ctx.should_stop(i):
+                    break
                 shards, opt_state, loss = step(shards, opt_state, batch)
                 log = (lambda lf, i=i:
                        print(f"[{name}] step {i:3d} loss {lf:.4f}")) \
                     if i % 5 == 0 or i == cfg.num_steps - 1 else None
-                pump.emit(loss,
-                          tokens=cfg.batch_size * cfg.sequence_length,
-                          log=log)
-    metrics = pump.metrics
+                synced = pump.emit(
+                    loss, tokens=cfg.batch_size * cfg.sequence_length,
+                    log=log)
+                ctx.after_step(i, synced, lambda i=i: RZ.RunState(
+                    params=shards, opt_state=opt_state, step=i,
+                    data_cursor=i + 1, prng_key=key,
+                    loss_log=ctx.full_losses(pump.losses)))
+        ctx.finalize(telem)
+    metrics = pump.metrics or {}
     print(f"[{name}] host syncs: {pump.host_sync_count} "
           f"({pump.sync_breakdown})")
     if prof:
@@ -155,4 +187,5 @@ def run(mode: str, argv=None):
               f"avg_loss {metrics.get('avg_loss', float('nan')):.4f}")
     if telem.run_dir:
         print(f"[{name}] telemetry in {telem.run_dir}")
+    metrics["losses"] = ctx.full_losses(pump.losses)
     return metrics

@@ -1,7 +1,11 @@
 """Shared pipeline-parallel driver behind ``scripts/gpipe.py`` and
 ``scripts/1f1b.py`` — the epoch loop, synthetic data, JSON results file and
 profiler of reference ``pp/gpipe.py:160-218`` / ``pp/1f1b.py:170-236``,
-factored once (the reference duplicates it per file, SURVEY.md §2.8)."""
+factored once (the reference duplicates it per file, SURVEY.md §2.8).
+Runs under the resilience supervisor at epoch granularity: a RunState
+checkpoint carries every stage's device-pinned params + Adam state, and
+``--resume`` re-enters ``train_pipeline`` at the saved epoch with the
+same fold_in(key, epoch) batch chain."""
 
 from __future__ import annotations
 
@@ -49,19 +53,31 @@ def main(schedule: str, argv=None):
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(args.cpu_devices)
 
-    import jax
-    import jax.numpy as jnp
-    from distributed_training_sandbox_tpu.utils import (
-        TrainConfig, set_seed, Profiler, ProfileSchedule)
-    from distributed_training_sandbox_tpu.models import pp_toy_mlp
-    from distributed_training_sandbox_tpu.models import transformer as T
-    from distributed_training_sandbox_tpu.models.mlp import PP_TOY_SIZES
-    from distributed_training_sandbox_tpu.parallel.pipeline import (
-        build_pipeline, build_transformer_pipeline, train_pipeline)
+    from distributed_training_sandbox_tpu.utils import TrainConfig
+    from distributed_training_sandbox_tpu import resilience as RZ
 
     cfg = TrainConfig.from_args(
         rest, batch_size=64, num_epochs=16,
         sequence_length=256 if args.model != "mlp" else 8192)
+    sup = RZ.Supervisor.from_config(
+        cfg, strategy=schedule,
+        extra_fingerprint={"model": args.model, "n_stages": args.n_stages,
+                           "n_micro": args.n_micro})
+    return sup.run(lambda ctx: _leg(schedule, args, cfg, ctx))
+
+
+def _leg(schedule, args, cfg, ctx):
+    import jax
+    from distributed_training_sandbox_tpu.utils import (
+        set_seed, Profiler, ProfileSchedule)
+    from distributed_training_sandbox_tpu.models import (
+        pp_toy_mlp, MODEL_REGISTRY as MODELS)
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.models.mlp import PP_TOY_SIZES
+    from distributed_training_sandbox_tpu.parallel.pipeline import (
+        build_pipeline, build_transformer_pipeline, train_pipeline)
+    from distributed_training_sandbox_tpu.resilience import RunState
+
     key = set_seed(cfg.seed)
     devices = None
     if schedule == "interleaved":
@@ -103,28 +119,50 @@ def main(schedule: str, argv=None):
     print(f"[{schedule}] model={args.model} stages={args.n_stages} "
           f"micro={args.n_micro} devices={devs}")
 
+    # resume: every stage's device-pinned params + Adam state restore in
+    # place (SingleDeviceSharding round-trips like any other sharding);
+    # the epoch cursor re-enters the fold_in(key, epoch) batch chain
+    rs = ctx.restore(like=RunState(
+        params=[s.params for s in stages],
+        opt_state=[s.opt_state for s in stages], prng_key=key))
+    if rs is not None:
+        for s, sp, so in zip(stages, rs.params, rs.opt_state):
+            s.params, s.opt_state = sp, so
+    start_epoch = ctx.start_step
+
     # choreography contract: stage programs must carry ZERO mesh
     # collectives — inter-stage comm is host-mediated device transfer.
     # gpipe vs 1f1b share the contract; interleaved rides on 1f1b's.
     from distributed_training_sandbox_tpu.analysis import evaluate_contract
     from distributed_training_sandbox_tpu.ops import count_collectives
-    x0, _ = make_batch(0)
+    x0, _ = make_batch(start_epoch)
     stage_counts = count_collectives(
         stages[0].fwd.lower(stages[0].params, x0).as_text())
     cname = schedule if schedule in ("gpipe", "1f1b") else "1f1b"
     verdict = evaluate_contract(cname, stage_counts,
                                 params=stages[0].params)
     print(f"[{schedule}] contract[{cname}]: {verdict.summary()}")
+    ctx.verify_contract(verdict)
 
     prof = Profiler(trace_dir=cfg.trace_dir,
                     schedule=ProfileSchedule(skip_first=2, wait=1, warmup=1,
                                              active=4)) if cfg.profile else None
 
+    ep_losses: list[float] = []
+
     def log(epoch, loss):
+        ep_losses.append(float(loss))
         if epoch % 4 == 0 or epoch == cfg.num_epochs - 1:
             print(f"[{schedule}] epoch {epoch:3d} loss {loss:.6f}")
         if prof:
             prof.step()
+        # pipeline schedules resolve the epoch loss host-side, so every
+        # epoch is a sync point for the checkpointer
+        ctx.after_step(epoch, True, lambda epoch=epoch: RunState(
+            params=[s.params for s in stages],
+            opt_state=[s.opt_state for s in stages],
+            step=epoch, data_cursor=epoch + 1, prng_key=key,
+            loss_log=ctx.full_losses(ep_losses)))
 
     if args.warmup_epochs:
         def lr_fn(e, *, _w=args.warmup_epochs, _lr=args.lr):
@@ -136,21 +174,28 @@ def main(schedule: str, argv=None):
     # commit to — but epoch e+1's synthetic batch can still be built
     # while the schedule runs epoch e.
     from distributed_training_sandbox_tpu.runtime import DevicePrefetcher
-    pref = DevicePrefetcher((make_batch(e) for e in range(cfg.num_epochs)),
-                            depth=cfg.prefetch_depth)
+    pref = DevicePrefetcher(
+        (make_batch(e) for e in range(start_epoch, cfg.num_epochs)),
+        depth=cfg.prefetch_depth)
     with pref:
         result = train_pipeline(stages, schedule,
                                 lambda e: next(pref),
                                 num_epochs=cfg.num_epochs,
                                 n_micro=args.n_micro,
-                                lr=lr_fn, log=log)
+                                lr=lr_fn, log=log,
+                                start_epoch=start_epoch,
+                                should_stop=ctx.should_stop)
     if prof:
         prof.stop()
+    ctx.finalize()   # final RunState save; raises Preempted on SIGTERM
 
     out = result.as_dict()   # incl. max_stored_activations + memory plan
     out["contract"] = verdict.to_dict()
     out["pump"] = {"prefetch_depth": cfg.prefetch_depth,
                    "dispatch": "host-prefetch"}
+    out["losses"] = ctx.full_losses(ep_losses)
+    if ctx.manifest_lineage():
+        out["resilience"] = ctx.manifest_lineage()
     print(f"[{schedule}] {json.dumps(out)}")
     if args.results_file:
         Path(args.results_file).write_text(json.dumps(out, indent=2))

@@ -7,6 +7,14 @@ the pass signal, plus step timing, the comm/compute split recovered from the
 leg's profiler trace (``utils.trace_analysis`` — the jit-world twin of the
 reference's in-step communication timers, ``zero/zero2.py:219-228``), and the
 per-step HLO collective counts (the trace-parity upgrade).
+
+Both legs run under the resilience supervisor with per-leg checkpoint
+scopes (``<ckpt_dir>/baseline``, ``<ckpt_dir>/sharded``): a preemption or
+injected crash mid-leg resumes THAT leg from its latest step — a leg that
+already completed replays nothing and contributes its checkpointed loss
+log to the A/B report, so the stitched sequences stay bitwise-identical
+to an uninterrupted run (``tests/test_resilience.py`` pins this for
+zero3's dp-sharded opt state).
 """
 
 from __future__ import annotations
@@ -22,35 +30,62 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def _time_steps(step_fn, state, batch, n_steps, telem=None, label="",
-                tokens_per_step=None, cfg=None):
+                tokens_per_step=None, cfg=None, ctx=None):
     """Run n_steps (first is untimed warmup/compile, like the reference's
-    explicit warmup step, zero1.py:118-125). Returns (state, losses, sec/step).
-    ``telem`` is the leg's TelemetryRun — it records each step AND advances
-    the profiler it owns.  The loop runs through the async step pump
-    (``cfg.dispatch``/``cfg.sync_every``/``cfg.max_in_flight``); the
-    timed window closes only after the pump drains, so sec/step stays an
-    honest amortized figure."""
+    explicit warmup step, zero1.py:118-125). Returns (state, losses, sec/step)
+    where ``losses`` is the FULL stitched sequence (restored + this
+    segment).  ``telem`` is the leg's TelemetryRun — it records each step
+    AND advances the profiler it owns.  The loop runs through the async
+    step pump (``cfg.dispatch``/``cfg.sync_every``/``cfg.max_in_flight``);
+    the timed window closes only after the pump drains, so sec/step stays
+    an honest amortized figure.  ``ctx`` is the leg's resilience scope:
+    its ``start_step`` skips already-checkpointed steps, ``should_stop``
+    honors faults/SIGTERM, ``after_step`` rides the pump sync points for
+    async RunState saves."""
     import jax
     from distributed_training_sandbox_tpu.runtime import StepPump
+    from distributed_training_sandbox_tpu.resilience import RunState
     params, opt = state
+    total = max(n_steps, 2)
+    start = ctx.start_step if ctx is not None else 0
+    if start >= total:
+        # this leg completed in a prior segment: nothing to recompute —
+        # report from the checkpointed loss log
+        losses = ctx.full_losses([])
+        print(f"[{label}] resume: all {total} steps already completed "
+              f"({len(losses)} checkpointed losses)")
+        if ctx is not None:
+            ctx.finalize(telem)
+        return (params, opt), losses, 0.0
     t0 = None
     pump = StepPump(telem=telem,
                     mode=cfg.dispatch if cfg else "async",
                     sync_every=cfg.sync_every if cfg else 10,
                     max_in_flight=cfg.max_in_flight if cfg else 16)
     with pump:
-        for i in range(max(n_steps, 2)):
+        for i in range(start, total):
+            if ctx is not None and ctx.should_stop(i):
+                break
             params, opt, loss = step_fn(params, opt, batch)
-            if i == 0:
+            if i == start:
                 # compile fence: discard the jit step from the timed
                 # window, as the reference's explicit warmup does
                 jax.block_until_ready(loss)  # sync-ok: pre-timing fence
                 t0 = time.perf_counter()
-            pump.emit(loss, tokens=tokens_per_step)
-    dt = (time.perf_counter() - t0) / max(n_steps - 1, 1)
-    losses = [l for idx, l in pump.resolved if idx > 0]
-    print(f"[{label}] {len(losses)} timed steps, {dt * 1e3:.2f} ms/step, "
-          f"final loss {losses[-1]:.6f} "
+            synced = pump.emit(loss, tokens=tokens_per_step)
+            if ctx is not None:
+                ctx.after_step(i, synced, lambda i=i: RunState(
+                    params=params, opt_state=opt, step=i,
+                    data_cursor=i + 1,
+                    loss_log=ctx.full_losses(pump.losses)))
+    if ctx is not None:
+        ctx.finalize(telem)   # final save; raises Preempted on SIGTERM
+    dt = (time.perf_counter() - t0) / max(total - start - 1, 1) \
+        if t0 is not None else 0.0
+    losses = ctx.full_losses(pump.losses) if ctx is not None \
+        else list(pump.losses)
+    print(f"[{label}] {max(len(losses) - 1, 0)} timed steps, "
+          f"{dt * 1e3:.2f} ms/step, final loss {losses[-1]:.6f} "
           f"(host syncs {pump.host_sync_count})")
     return (params, opt), losses, dt
 
@@ -67,10 +102,21 @@ def run_zero_ab(stage: int, argv=None):
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(args.cpu_devices)
 
+    from distributed_training_sandbox_tpu.utils import TrainConfig
+    from distributed_training_sandbox_tpu import resilience as RZ
+
+    cfg = TrainConfig.from_args(rest, batch_size=16)
+    sup = RZ.Supervisor.from_config(
+        cfg, strategy=f"zero{stage}",
+        extra_fingerprint={"scale": args.scale, "rebuild": args.rebuild})
+    return sup.run(lambda ctx: _zero_ab_leg(stage, args, cfg, ctx))
+
+
+def _zero_ab_leg(stage, args, cfg, root_ctx):
     import jax
     import numpy as np
     from distributed_training_sandbox_tpu.utils import (
-        TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
+        set_seed, make_mesh, get, Profiler, ProfileSchedule,
         tree_size_mb, tree_local_size_mb, print_memory_stats)
     from distributed_training_sandbox_tpu.utils.trace_analysis import (
         split_from_trace)
@@ -81,8 +127,8 @@ def run_zero_ab(stage: int, argv=None):
         make_zero_train_step, init_zero_opt_state, make_zero3_train_step,
         make_zero3_mlp_loss, shard_params_zero3)
     from distributed_training_sandbox_tpu.ops import count_collectives
+    from distributed_training_sandbox_tpu.resilience import RunState
 
-    cfg = TrainConfig.from_args(rest, batch_size=16)
     mesh = make_mesh()
     ws = get("ws")
     name = f"zero{stage}"
@@ -104,6 +150,11 @@ def run_zero_ab(stage: int, argv=None):
                   jax.random.normal(ky, (cfg.batch_size, width))))
     params = jax.tree.map(lambda a: host_to_global(a, mesh, P()), params)
 
+    # per-leg resilience scopes: own checkpoint subdir + resume position,
+    # shared SIGTERM flag / fault injector / lineage
+    ctx_a = root_ctx.scope("baseline")
+    ctx_b = root_ctx.scope("sharded")
+
     # fresh Profiler per leg: a repeat=1 schedule is consumed by the first
     # leg's steps, so sharing one would leave the sharded leg untraced
     def make_prof(leg):
@@ -116,24 +167,30 @@ def run_zero_ab(stage: int, argv=None):
 
     # ---- leg A: baseline Adam (replicated state, DDP-style) --------------
     base_opt = optim.adam_init(params)
+    base_state = (params, base_opt)
+    rs = ctx_a.restore(like=RunState(params=params, opt_state=base_opt))
+    if rs is not None:
+        base_state = (rs.params, rs.opt_state)
     base_step = make_ddp_train_step(
         mse_loss, lambda g, s, p: optim.adam_update(g, s, p), mesh, "dp",
         donate=False)
-    base_counts = count_collectives(base_step, params, base_opt, batch)
+    base_counts = count_collectives(base_step, *base_state, batch)
     from distributed_training_sandbox_tpu.analysis import evaluate_contract
     base_verdict = evaluate_contract("ddp", base_counts, params=params,
                                      mesh=mesh)
     print(f"[{name}] contract[ddp/baseline]: {base_verdict.summary()}")
+    ctx_a.verify_contract(base_verdict)
     # one TelemetryRun per leg: the crash-safe owner of that leg's profiler
     with TelemetryRun(f"{name}-baseline", config=cfg, mesh=mesh,
                       model="toy-mlp", collective_counts=base_counts,
                       contract=base_verdict.to_dict(),
+                      lineage=ctx_a.manifest_lineage(),
                       profiler=make_prof("baseline"),
                       extra={"leg": "baseline", "stage": stage,
                              "scale": args.scale}) as telem_a:
         (_, base_opt_f), base_losses, base_dt = _time_steps(
-            base_step, (params, base_opt), batch, cfg.num_steps, telem_a,
-            "baseline", tokens_per_step=cfg.batch_size, cfg=cfg)
+            base_step, base_state, batch, cfg.num_steps, telem_a,
+            "baseline", tokens_per_step=cfg.batch_size, cfg=cfg, ctx=ctx_a)
     base_opt_mb = tree_local_size_mb(base_opt_f.mu) + \
         tree_local_size_mb(base_opt_f.nu)
 
@@ -148,6 +205,9 @@ def run_zero_ab(stage: int, argv=None):
         loss_fn = make_zero3_mlp_loss(shapes, "dp")
         step = make_zero3_train_step(loss_fn, mesh, "dp", donate=False)
         state0 = (shard_params_zero3(params, mesh, "dp"), opt)
+    rs = ctx_b.restore(like=RunState(params=state0[0], opt_state=state0[1]))
+    if rs is not None:
+        state0 = (rs.params, rs.opt_state)
     shard_counts = count_collectives(step, *state0, batch)
     # zero3's rebuild knob is fixed (all_gather materialize); 1/2 honor
     # --rebuild, which the contract formula needs to pick the right counts
@@ -155,16 +215,18 @@ def run_zero_ab(stage: int, argv=None):
         name, shard_counts, params=params, mesh=mesh,
         **({"rebuild": args.rebuild} if stage in (1, 2) else {}))
     print(f"[{name}] contract[{name}]: {shard_verdict.summary()}")
+    ctx_b.verify_contract(shard_verdict)
     with TelemetryRun(name, config=cfg, mesh=mesh, model="toy-mlp",
                       collective_counts=shard_counts,
                       contract=shard_verdict.to_dict(),
+                      lineage=ctx_b.manifest_lineage(),
                       profiler=make_prof("sharded"),
                       extra={"leg": "sharded", "stage": stage,
                              "scale": args.scale,
                              "rebuild": args.rebuild}) as telem_b:
         (shard_params_f, opt_f), shard_losses, shard_dt = _time_steps(
             step, state0, batch, cfg.num_steps, telem_b, name,
-            tokens_per_step=cfg.batch_size, cfg=cfg)
+            tokens_per_step=cfg.batch_size, cfg=cfg, ctx=ctx_b)
     shard_opt_mb = tree_local_size_mb(opt_f.mu) + tree_local_size_mb(opt_f.nu)
 
     # ---- comparison report (the reference's pass signal) -----------------
@@ -204,6 +266,7 @@ def run_zero_ab(stage: int, argv=None):
         "base_opt_mb": base_opt_mb, "shard_opt_mb": shard_opt_mb,
         "base_ms": base_dt * 1e3, "shard_ms": shard_dt * 1e3,
         "base_counts": base_counts, "shard_counts": shard_counts,
+        "base_losses": base_losses, "shard_losses": shard_losses,
         "loss_drift": float(drift),
         "comm_split": splits,
     }

@@ -15,9 +15,17 @@ Two payloads, selected by ``--model``:
     ``DDP/training_utils/utils.py:17-107``; GLUE MRPC gated behind network,
     deterministic synthetic pairs offline).
 
+Both legs run under the resilience supervisor: ``--checkpoint-dir`` /
+``--checkpoint-every`` save the full RunState (params, opt state, PRNG
+root, host data cursor, loss log) asynchronously at the pump's sync
+points; ``--resume`` / ``--max-restarts`` resume bit-exactly — the
+stitched loss sequence equals the uninterrupted run's, which
+``tests/test_resilience.py`` pins.
+
 Usage:
   python scripts/ddp.py --num-steps 20 [--cpu-devices 8] [--scale 20]
   python scripts/ddp.py --model smollm3-350m --num-steps 20 [--batch-size 32]
+  python scripts/ddp.py --checkpoint-dir /tmp/ck --checkpoint-every 5 --resume
 """
 
 from __future__ import annotations
@@ -55,14 +63,26 @@ def main(argv=None):
     if args.model != "mlp":
         return classification_main(args, rest)
 
+    from distributed_training_sandbox_tpu.utils import TrainConfig
+    from distributed_training_sandbox_tpu import resilience as RZ
+
+    cfg = TrainConfig.from_args(rest, batch_size=32)
+    sup = RZ.Supervisor.from_config(cfg, strategy="ddp",
+                                    extra_fingerprint={"scale": args.scale})
+    return sup.run(lambda ctx: _mlp_leg(args, cfg, ctx))
+
+
+def _mlp_leg(args, cfg, ctx):
+    import itertools
+
     import jax
-    import jax.numpy as jnp
     from distributed_training_sandbox_tpu.utils import (
-        TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
+        set_seed, make_mesh, get, Profiler, ProfileSchedule,
         PerformanceTracker, print_memory_stats)
     from distributed_training_sandbox_tpu.telemetry import TelemetryRun
     from distributed_training_sandbox_tpu.runtime import (
         DevicePrefetcher, StepPump)
+    from distributed_training_sandbox_tpu import resilience as RZ
     from distributed_training_sandbox_tpu.models import zero_toy_mlp
     from distributed_training_sandbox_tpu.models.mlp import mse_loss
     from distributed_training_sandbox_tpu.parallel import (
@@ -70,7 +90,6 @@ def main(argv=None):
     from distributed_training_sandbox_tpu.ops import smap, count_collectives
     from jax.sharding import PartitionSpec as P
 
-    cfg = TrainConfig.from_args(rest, batch_size=32)
     mesh = make_mesh()
     ws = get("ws")
     print(f"[ddp] mesh={dict(mesh.shape)} devices={ws} "
@@ -91,6 +110,12 @@ def main(argv=None):
     print(f"[ddp] param sync check passed (divergence {err})")
 
     opt_state = optim.sgd_init(params)
+    # resume: restore params/opt/PRNG root before the step is lowered so
+    # the collective contract below is evaluated on the RESTORED state
+    rs = ctx.restore(like=RZ.RunState(params=params, opt_state=opt_state,
+                                      prng_key=key))
+    if rs is not None:
+        params, opt_state = rs.params, rs.opt_state
     contract_name = "ddp_bucketed" if cfg.bucket_mb else "ddp"
     step = make_ddp_train_step(
         mse_loss, lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3),
@@ -120,6 +145,7 @@ def main(argv=None):
         contract_name, counts, params=params, mesh=mesh,
         **({"bucket_mb": cfg.bucket_mb} if cfg.bucket_mb else {}))
     print(f"[ddp] contract[{contract_name}]: {verdict.summary()}")
+    ctx.verify_contract(verdict)
 
     tracker = PerformanceTracker(warmup_steps=min(5, cfg.num_steps - 1) if
                                  cfg.num_steps > 1 else 0)
@@ -129,23 +155,37 @@ def main(argv=None):
     # hot loop: prefetcher stages sharded batches in a background thread;
     # the pump retires losses per the sync policy (no per-step host sync).
     # TelemetryRun owns the profiler: a crash mid-loop still flushes the
-    # in-flight trace and writes a status="crashed" summary.
-    pref = DevicePrefetcher(batch_stream(key), mesh=mesh, spec=P("dp"),
+    # in-flight trace and writes a status="crashed" summary.  On resume
+    # the deterministic stream is rebuilt and fast-forwarded past the
+    # data cursor — the host-side "PRNG position" of the run.
+    stream = batch_stream(key)
+    if ctx.data_cursor:
+        stream = itertools.islice(stream, ctx.data_cursor, None)
+    pref = DevicePrefetcher(stream, mesh=mesh, spec=P("dp"),
                             depth=cfg.prefetch_depth)
     with pref, TelemetryRun("ddp", config=cfg, mesh=mesh, model="mlp",
                             collective_counts=counts,
                             contract=verdict.to_dict(),
+                            lineage=ctx.manifest_lineage(),
                             profiler=prof) as telem:
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight) as pump:
-            for i, batch in zip(range(cfg.num_steps), pref):
+            for i, batch in zip(range(ctx.start_step, cfg.num_steps), pref):
+                if ctx.should_stop(i):
+                    break
                 params, opt_state, loss = step(params, opt_state, batch)
                 log = (lambda lf, i=i:
                        print(f"[ddp] step {i:3d} loss {lf:.6f}")) \
                     if i % 5 == 0 or i == cfg.num_steps - 1 else None
-                pump.emit(loss, tokens=cfg.batch_size, log=log)
-    metrics = pump.metrics
+                synced = pump.emit(loss, tokens=cfg.batch_size, log=log)
+                ctx.after_step(i, synced, lambda i=i: RZ.RunState(
+                    params=params, opt_state=opt_state, step=i,
+                    data_cursor=i + 1, prng_key=key,
+                    loss_log=ctx.full_losses(pump.losses)))
+        # pump drained: final checkpoint; raises Preempted after SIGTERM
+        ctx.finalize(telem)
+    metrics = pump.metrics or {}
     print(f"[ddp] host syncs: {pump.host_sync_count} "
           f"({pump.sync_breakdown})")
 
@@ -156,21 +196,35 @@ def main(argv=None):
     if telem.run_dir:
         print(f"[ddp] telemetry in {telem.run_dir}")
     print(f"[ddp] traces in {cfg.trace_dir}" if cfg.profile else "[ddp] done")
+    metrics["losses"] = ctx.full_losses(pump.losses)
     return metrics
 
 
 def classification_main(args, rest):
+    from distributed_training_sandbox_tpu.utils import TrainConfig
+    from distributed_training_sandbox_tpu import resilience as RZ
+
+    # per-device bs 32 tuned for A10G in the reference (DDP/ddp.py:99);
+    # the global default here is 32 total, overridable via --batch-size.
+    cfg = TrainConfig.from_args(rest, batch_size=32)
+    sup = RZ.Supervisor.from_config(cfg, strategy="ddp",
+                                    extra_fingerprint={"model": args.model})
+    return sup.run(lambda ctx: _classification_leg(args, cfg, ctx))
+
+
+def _classification_leg(args, cfg, ctx):
     """The real-data leg: 350M-class trunk + classification head, padded
     sentence pairs, same DDP choreography (broadcast + assert, per-param
     grad all_reduce, SGD — reference ``DDP/ddp.py:84-126``)."""
     import jax
     import jax.numpy as jnp
     from distributed_training_sandbox_tpu.utils import (
-        TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
+        set_seed, make_mesh, get, Profiler, ProfileSchedule,
         PerformanceTracker, print_memory_stats)
     from distributed_training_sandbox_tpu.telemetry import TelemetryRun
     from distributed_training_sandbox_tpu.runtime import (
         DevicePrefetcher, StepPump)
+    from distributed_training_sandbox_tpu import resilience as RZ
     from distributed_training_sandbox_tpu.models import (
         transformer as T, init_classifier_params, classification_loss,
         classification_accuracy, MODEL_REGISTRY)
@@ -182,9 +236,6 @@ def classification_main(args, rest):
     from jax.sharding import PartitionSpec as P
     import functools
 
-    # per-device bs 32 tuned for A10G in the reference (DDP/ddp.py:99);
-    # the global default here is 32 total, overridable via --batch-size.
-    cfg = TrainConfig.from_args(rest, batch_size=32)
     mcfg: T.TransformerConfig = getattr(T, MODEL_REGISTRY[args.model])
     mesh = make_mesh()
     ws = get("ws")
@@ -211,6 +262,10 @@ def classification_main(args, rest):
           f"(per-rank contiguous shards, pad-to-multiple-of-8 collate)")
 
     opt_state = optim.sgd_init(params)
+    rs = ctx.restore(like=RZ.RunState(params=params, opt_state=opt_state,
+                                      prng_key=key))
+    if rs is not None:
+        params, opt_state = rs.params, rs.opt_state
     loss_fn = functools.partial(classification_loss, cfg=mcfg)
     contract_name = "ddp_bucketed" if cfg.bucket_mb else "ddp"
     step = make_ddp_train_step(
@@ -234,6 +289,7 @@ def classification_main(args, rest):
         contract_name, counts, params=params, mesh=mesh,
         **({"bucket_mb": cfg.bucket_mb} if cfg.bucket_mb else {}))
     print(f"[ddp] contract[{contract_name}]: {verdict.summary()}")
+    ctx.verify_contract(verdict)
 
     tracker = PerformanceTracker(warmup_steps=min(3, cfg.num_steps - 1) if
                                  cfg.num_steps > 1 else 0)
@@ -241,20 +297,27 @@ def classification_main(args, rest):
                     schedule=ProfileSchedule(skip_first=5, wait=1, warmup=2,
                                              active=5)) if cfg.profile else None
     # batches enter committed under the step's dp sharding (device_put in
-    # the prefetcher thread), not a replicated/uncommitted jnp.asarray
+    # the prefetcher thread), not a replicated/uncommitted jnp.asarray;
+    # a resume rebuilds the deterministic epoch stream and fast-forwards
+    # past the batches segment 1 already consumed
     import itertools
-    pref = DevicePrefetcher(itertools.chain([first], batches),
-                            mesh=mesh, spec=P("dp"),
+    stream = itertools.chain([first], batches)
+    if ctx.data_cursor:
+        stream = itertools.islice(stream, ctx.data_cursor, None)
+    pref = DevicePrefetcher(stream, mesh=mesh, spec=P("dp"),
                             depth=cfg.prefetch_depth)
     with pref, TelemetryRun("ddp", config=cfg, mesh=mesh, model=args.model,
                             collective_counts=counts,
                             contract=verdict.to_dict(),
+                            lineage=ctx.manifest_lineage(),
                             profiler=prof) as telem:
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight) as pump:
-            for i, jbatch in zip(range(cfg.num_steps), pref):
-                if i == 0:
+            for i, jbatch in zip(range(ctx.start_step, cfg.num_steps), pref):
+                if ctx.should_stop(i):
+                    break
+                if i == ctx.start_step:
                     sh = jbatch["input_ids"].sharding
                     assert getattr(sh, "spec", None) == P("dp"), \
                         f"batch not dp-sharded: {sh}"
@@ -264,9 +327,15 @@ def classification_main(args, rest):
                        print(f"[ddp] step {i:3d} loss {lf:.4f} "
                              f"(padded width {w})")) \
                     if i % 5 == 0 or i == cfg.num_steps - 1 else None
-                pump.emit(loss, tokens=int(jbatch["input_ids"].size),
-                          log=log)
-    metrics = pump.metrics
+                synced = pump.emit(loss,
+                                   tokens=int(jbatch["input_ids"].size),
+                                   log=log)
+                ctx.after_step(i, synced, lambda i=i: RZ.RunState(
+                    params=params, opt_state=opt_state, step=i,
+                    data_cursor=i + 1, prng_key=key,
+                    loss_log=ctx.full_losses(pump.losses)))
+        ctx.finalize(telem)
+    metrics = pump.metrics or {}
     print(f"[ddp] host syncs: {pump.host_sync_count} "
           f"({pump.sync_breakdown})")
 
@@ -281,6 +350,7 @@ def classification_main(args, rest):
               f"train-batch acc {acc:.3f}")
     if telem.run_dir:
         print(f"[ddp] telemetry in {telem.run_dir}")
+    metrics["losses"] = ctx.full_losses(pump.losses)
     return metrics
 
 

@@ -93,10 +93,12 @@ def main(argv=None):
     params = T.init_params(set_seed(42), mcfg)
     restored_step = None
     if args.ckpt_dir:
+        # restore-and-report goes through ONE code path (the "restored
+        # step N from DIR" line included) — utils.checkpoint.restore_params
         from distributed_training_sandbox_tpu.utils.checkpoint import (
             restore_params)
-        params, restored_step = restore_params(args.ckpt_dir, params)
-        print(f"[eval] restored step {restored_step} from {args.ckpt_dir}")
+        params, restored_step = restore_params(args.ckpt_dir, params,
+                                               tag="eval")
 
     loss_fn = jax.jit(lambda p, b: T.lm_loss(p, b, mcfg))
     tot, steps = 0.0, 0

@@ -69,8 +69,9 @@ def main(argv=None):
         mcfg, attention_impl=("flash" if jax.default_backend() == "tpu"
                               else "xla"))
     params = T.init_params(set_seed(42), mcfg)
-    params, step = restore_params(args.ckpt_dir, params)
-    print(f"[demo] restored step {step} from {args.ckpt_dir}")
+    # restore-and-report through the one shared code path (prints the
+    # "restored step N from DIR" contract line under this tag)
+    params, step = restore_params(args.ckpt_dir, params, tag="demo")
     if args.int8:
         params = quantize_decode_params(params, mcfg)
 

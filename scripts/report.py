@@ -88,6 +88,9 @@ def main(argv=None) -> int:
         print(f"# Telemetry report — {len(rows)} run(s) from "
               f"{', '.join(args.paths)}\n")
         print(R.render_table(rows))
+        if any(r.get("lineage") for r in rows):
+            print("\n## Restart lineage (stitched segments)\n")
+            print(R.render_lineage(rows))
         if args.steps:
             for rec in recs:
                 tail = R.load_steps(rec["dir"])[-5:]

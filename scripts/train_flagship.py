@@ -40,7 +40,10 @@ from distributed_training_sandbox_tpu.models import MODEL_REGISTRY  # noqa: E402
 def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
             warmup_steps: int, peak_lr: float, out_dir: Path,
             tag_suffix: str = "", data: str = "synthetic",
-            ckpt_dir: str | None = None) -> dict:
+            ckpt_dir: str | None = None, ckpt_every: int = 0,
+            resume: bool = False) -> dict:
+    import itertools
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -48,6 +51,8 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
         make_packed_dataset, packed_batches)
     from distributed_training_sandbox_tpu.models import transformer as T
     from distributed_training_sandbox_tpu.parallel import fsdp, optim
+    from distributed_training_sandbox_tpu.resilience import (
+        Checkpointer, RunState)
     from distributed_training_sandbox_tpu.utils import make_mesh, set_seed
 
     mcfg = getattr(T, MODEL_REGISTRY[model])
@@ -104,10 +109,35 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
                                      source="synthetic", engine="native")
         epochs = 1
 
-    losses, lrs, times = [], [], []
+    # resilience: the flagship is the run most worth preempting safely —
+    # RunState checkpoints (params + opt + PRNG + cursor + loss log) live
+    # under <ckpt_dir>/runstate; the params-only FINAL save below stays at
+    # the root so scripts/eval_lm.py's restore contract is unchanged
+    ckptr = Checkpointer(Path(ckpt_dir) / "runstate", every=ckpt_every,
+                         fingerprint={"strategy": "flagship",
+                                      "model": model, "seed": 42,
+                                      "precision": precision,
+                                      "batch_size": bs}) \
+        if ckpt_dir and (ckpt_every or resume) else None
+    start, prior_losses = 0, []
+    if resume and ckptr is not None:
+        rs = ckptr.restore_latest(RunState(params=shards, opt_state=opt,
+                                           prng_key=key))
+        if rs is not None:
+            shards, opt, start = rs.params, rs.opt_state, rs.step + 1
+            prior_losses = rs.loss_log
+            print(f"[flagship] resumed from step {rs.step} "
+                  f"({len(prior_losses)} losses) in {ckptr.directory}")
+
+    losses, lrs, times = list(prior_losses), [], []
+    # lr series is schedule-determined — rebuild the restored prefix
+    lrs = [float(sched(jnp.asarray(i)) if sched else peak_lr)
+           for i in range(start)]
     t0 = time.perf_counter()
-    for i, (ib, lb) in enumerate(packed_batches(ii, ll, bs,
-                                                epochs=epochs)):
+    batches = packed_batches(ii, ll, bs, epochs=epochs)
+    if start:
+        batches = itertools.islice(batches, start, None)
+    for i, (ib, lb) in enumerate(batches, start=start):
         if i >= num_steps:
             break
         shards, opt, loss = step(shards, opt,
@@ -118,16 +148,29 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
         if i % 25 == 0 or i == num_steps - 1:
             print(f"[flagship] step {i:4d} loss {losses[-1]:8.4f} "
                   f"lr {lrs[-1]:.2e} ({times[-1]:.0f}s)", flush=True)
-    dt = times[-1] - times[1] if len(times) > 2 else times[-1]
-    tok_s = (len(losses) - 1) * bs * seq / dt if dt > 0 else 0.0
+        if ckptr is not None:
+            # this loop resolves the loss host-side every step, so every
+            # step is a sync point for the async save policy
+            ckptr.maybe_save(i, lambda i=i: RunState(
+                params=shards, opt_state=opt, step=i, data_cursor=i + 1,
+                prng_key=key, loss_log=list(losses)), synced=True)
+    n_new = len(losses) - len(prior_losses)
+    dt = times[-1] - times[1] if len(times) > 2 else \
+        (times[-1] if times else 0.0)
+    tok_s = max(n_new - 1, 0) * bs * seq / dt if dt > 0 else 0.0
 
+    if ckptr is not None:
+        ckptr.save_final(RunState(
+            params=shards, opt_state=opt, step=len(losses) - 1,
+            data_cursor=len(losses), prng_key=key, loss_log=list(losses)))
+        ckptr.close()
     if ckpt_dir:
         # final-state Orbax save: scripts/eval_lm.py restores it (the
-        # train -> checkpoint -> eval lifecycle)
+        # train -> checkpoint -> eval lifecycle).  closing() guarantees
+        # wait_until_finished on every exit path (torn-save hazard).
         from distributed_training_sandbox_tpu.utils import checkpoint as C
-        mgr = C.checkpoint_manager(ckpt_dir)
-        C.save_state(mgr, len(losses), {"params": shards})
-        mgr.wait_until_finished()
+        with C.closing(C.checkpoint_manager(ckpt_dir)) as mgr:
+            C.save_state(mgr, len(losses), {"params": shards}, wait=False)
         print(f"[flagship] checkpoint step {len(losses)} -> {ckpt_dir}")
 
     warm = f"warm{warmup_steps}" if warmup_steps else "nowarm"
@@ -197,6 +240,13 @@ def main(argv=None):
     p.add_argument("--ckpt-dir", default=None,
                    help="save the final params as an Orbax checkpoint "
                         "(scripts/eval_lm.py restores it)")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="also save full RunState (params+opt+PRNG+data "
+                        "cursor) every N steps under <ckpt-dir>/runstate "
+                        "for preemption-safe --resume")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest RunState step in "
+                        "<ckpt-dir>/runstate (bit-exact continuation)")
     p.add_argument("--cpu-devices", type=int, default=0)
     p.add_argument("--out-dir", default="flagship_results")
     p.add_argument("--plot", default="plots/flagship_loss.png")
@@ -214,7 +264,8 @@ def main(argv=None):
     run_leg(args.model, args.precision, args.sequence_length,
             args.batch_size, args.num_steps, args.warmup_steps,
             args.peak_lr, out_dir, data=args.data,
-            ckpt_dir=args.ckpt_dir)
+            ckpt_dir=args.ckpt_dir, ckpt_every=args.checkpoint_every,
+            resume=args.resume)
     plot(out_dir, Path(args.plot))
 
 

@@ -6,6 +6,9 @@ Same flow: model from config (random init, bf16), TinyStories packed dataset
 gather (ZeRO-2) via ``--no-reshard-after-forward``, AdamW-on-shards,
 warmup-aware PerformanceTracker (tokens/s + TFLOPS/device), rank-0 profiler
 (wait=5 warmup=5 active=10 — reference ``fsdp/train_fsdp.py:124-137``).
+Runs under the resilience supervisor: ``--checkpoint-dir/--checkpoint-every/
+--resume/--max-restarts`` give preemption-safe bit-exact resume of the
+dp-sharded params + opt state, data cursor included.
 
 Usage:
   python scripts/train_fsdp.py --num-steps 20 --sequence-length 8192 \
@@ -45,17 +48,32 @@ def main(argv=None):
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(args.cpu_devices)
 
+    from distributed_training_sandbox_tpu.utils import TrainConfig
+    from distributed_training_sandbox_tpu import resilience as RZ
+
+    cfg = TrainConfig.from_args(
+        rest, sequence_length=256 if args.model == "tiny" else 8192)
+    sup = RZ.Supervisor.from_config(
+        cfg, strategy="fsdp",
+        extra_fingerprint={"model": args.model, "variant": args.variant})
+    return sup.run(lambda ctx: _leg(args, rest, cfg, ctx))
+
+
+def _leg(args, rest, cfg, ctx):
+    import itertools
+
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
     from distributed_training_sandbox_tpu.utils import (
-        TrainConfig, set_seed, make_mesh, get, Profiler, ProfileSchedule,
+        set_seed, make_mesh, get, Profiler, ProfileSchedule,
         PerformanceTracker, print_memory_stats)
     from distributed_training_sandbox_tpu.utils.flops import (
         get_model_flops_per_token)
     from distributed_training_sandbox_tpu.telemetry import TelemetryRun
     from distributed_training_sandbox_tpu.runtime import (
         DevicePrefetcher, StepPump)
+    from distributed_training_sandbox_tpu import resilience as RZ
     from distributed_training_sandbox_tpu.models import transformer as T
     from distributed_training_sandbox_tpu.parallel import fsdp
     from distributed_training_sandbox_tpu.ops import count_collectives
@@ -65,8 +83,6 @@ def main(argv=None):
     def flag_given(flag):
         return any(r == flag or r.startswith(flag + "=") for r in rest or [])
 
-    cfg = TrainConfig.from_args(
-        rest, sequence_length=256 if args.model == "tiny" else 8192)
     mcfg: T.TransformerConfig = getattr(T, MODELS[args.model])
     if args.attention:
         mcfg = dataclasses.replace(mcfg, attention_impl=args.attention)
@@ -97,6 +113,12 @@ def main(argv=None):
     del params
     opt_state = fsdp.init_fsdp_opt_state(shards)
     print_memory_stats("fsdp-at-rest", params=shards, opt_state=opt_state)
+    # resume BEFORE lowering: the contract below then checks the restored
+    # state's actual sharding choreography
+    rs = ctx.restore(like=RZ.RunState(params=shards, opt_state=opt_state,
+                                      prng_key=key))
+    if rs is not None:
+        shards, opt_state = rs.params, rs.opt_state
 
     if args.variant == "explicit":
         step = fsdp.make_fsdp_train_step(
@@ -131,10 +153,15 @@ def main(argv=None):
                                     mesh=mesh,
                                     n_layers=mcfg.num_hidden_layers)
         print(f"[fsdp] contract[fsdp]: {verdict.summary()}")
+    ctx.verify_contract(verdict)
 
     tokens_per_step = cfg.batch_size * cfg.sequence_length
     batches = packed_batches(input_ids, labels, cfg.batch_size,
                              epochs=cfg.num_epochs * cfg.num_steps)
+    if ctx.data_cursor:
+        # resume: the dataset rebuild above is seed-deterministic — skip
+        # the batches segment 1 already consumed
+        batches = itertools.islice(batches, ctx.data_cursor, None)
     # prefetcher stages (ids, labels) committed under the step's dp batch
     # sharding; pump retires losses per the sync policy
     pref = DevicePrefetcher(batches, mesh=mesh, spec=P("dp"),
@@ -143,18 +170,26 @@ def main(argv=None):
             "fsdp", config=cfg, mesh=mesh, model=args.model,
             collective_counts=counts, profiler=prof,
             contract=verdict.to_dict() if verdict else None,
+            lineage=ctx.manifest_lineage(),
             extra={"variant": args.variant,
                    "reshard_after_forward": args.reshard}) as telem:
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight) as pump:
-            for i, batch in zip(range(cfg.num_steps), pref):
+            for i, batch in zip(range(ctx.start_step, cfg.num_steps), pref):
+                if ctx.should_stop(i):
+                    break
                 shards, opt_state, loss = step(shards, opt_state, batch)
                 log = (lambda lf, i=i:
                        print(f"[fsdp] step {i:3d} loss {lf:.4f}")) \
                     if i % 5 == 0 or i == cfg.num_steps - 1 else None
-                pump.emit(loss, tokens=tokens_per_step, log=log)
-    metrics = pump.metrics
+                synced = pump.emit(loss, tokens=tokens_per_step, log=log)
+                ctx.after_step(i, synced, lambda i=i: RZ.RunState(
+                    params=shards, opt_state=opt_state, step=i,
+                    data_cursor=i + 1, prng_key=key,
+                    loss_log=ctx.full_losses(pump.losses)))
+        ctx.finalize(telem)
+    metrics = pump.metrics or {}
     print(f"[fsdp] host syncs: {pump.host_sync_count} "
           f"({pump.sync_breakdown})")
     if prof:
@@ -172,6 +207,7 @@ def main(argv=None):
               f"avg_loss {metrics.get('avg_loss', float('nan')):.4f}")
     if telem.run_dir:
         print(f"[fsdp] telemetry in {telem.run_dir}")
+    metrics["losses"] = ctx.full_losses(pump.losses)
     return metrics
 
 

@@ -1,6 +1,8 @@
 """MoE-transformer training: switch-MoE MLP in every layer, dp × ep
 (the reference's MoE story is one README learning note — SURVEY.md §2.2;
-see ``parallel/expert.py`` and ``TransformerConfig.n_experts``).
+see ``parallel/expert.py`` and ``TransformerConfig.n_experts``).  Runs
+under the resilience supervisor — the ep-sharded expert leaves round-trip
+through RunState checkpoints with their shardings intact.
 
   python scripts/train_moe.py --cpu-devices 8 --ep 4 --experts 8 \\
       --num-steps 10
@@ -43,6 +45,21 @@ def main(argv=None):
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(args.cpu_devices)
 
+    from distributed_training_sandbox_tpu.utils import TrainConfig
+    from distributed_training_sandbox_tpu import resilience as RZ
+
+    cfg = TrainConfig.from_args(
+        rest, sequence_length=256 if args.model == "tiny" else 8192)
+    sup = RZ.Supervisor.from_config(
+        cfg, strategy="moe",
+        extra_fingerprint={"model": args.model, "ep": args.ep,
+                           "experts": args.experts})
+    return sup.run(lambda ctx: _leg(args, rest, cfg, ctx))
+
+
+def _leg(args, rest, cfg, ctx):
+    import itertools
+
     import jax
     import jax.numpy as jnp
     from distributed_training_sandbox_tpu.data import (
@@ -51,17 +68,16 @@ def main(argv=None):
     from distributed_training_sandbox_tpu.ops import count_collectives
     from distributed_training_sandbox_tpu.parallel import expert, fsdp
     from distributed_training_sandbox_tpu.utils import (
-        PerformanceTracker, ProfileSchedule, Profiler, TrainConfig,
+        PerformanceTracker, ProfileSchedule, Profiler,
         make_mesh, print_memory_stats, set_seed)
     from distributed_training_sandbox_tpu.utils.flops import (
         get_model_flops_per_token)
     from distributed_training_sandbox_tpu.telemetry import TelemetryRun
     from distributed_training_sandbox_tpu.runtime import (
         DevicePrefetcher, StepPump)
+    from distributed_training_sandbox_tpu import resilience as RZ
     from jax.sharding import PartitionSpec as P
 
-    cfg = TrainConfig.from_args(
-        rest, sequence_length=256 if args.model == "tiny" else 8192)
     n_dev = len(jax.devices())
     if args.ep < 1 or n_dev % args.ep:
         raise SystemExit(f"--ep {args.ep} must be >= 1 and divide device "
@@ -102,6 +118,10 @@ def main(argv=None):
     opt_state = fsdp.init_fsdp_opt_state(shards)
     print_memory_stats("train_moe-at-rest", params=shards,
                        opt_state=opt_state)
+    rs = ctx.restore(like=RZ.RunState(params=shards, opt_state=opt_state,
+                                      prng_key=key))
+    if rs is not None:
+        shards, opt_state = rs.params, rs.opt_state
     step = expert.make_moe_lm_train_step(shards, mcfg, mesh)
 
     input_ids, labels = make_packed_dataset(
@@ -117,6 +137,7 @@ def main(argv=None):
                                 n_layers=mcfg.num_hidden_layers,
                                 top_k=args.top_k)
     print(f"[train_moe] contract[moe]: {verdict.summary()}")
+    ctx.verify_contract(verdict)
 
     tracker = PerformanceTracker(
         warmup_steps=min(3, max(cfg.num_steps - 1, 0)),
@@ -129,6 +150,8 @@ def main(argv=None):
         if cfg.profile else None
     batches = packed_batches(input_ids, labels, cfg.batch_size,
                              epochs=cfg.num_epochs * cfg.num_steps)
+    if ctx.data_cursor:
+        batches = itertools.islice(batches, ctx.data_cursor, None)
     # batch dim is sharded over the flattened (dp, ep) axes in the moe
     # step's in_spec — stage it that way from the prefetcher thread
     pref = DevicePrefetcher(batches, mesh=mesh, spec=P(("dp", "ep")),
@@ -137,20 +160,28 @@ def main(argv=None):
             "moe", config=cfg, mesh=mesh, model=args.model,
             collective_counts=counts, profiler=prof,
             contract=verdict.to_dict(),
+            lineage=ctx.manifest_lineage(),
             extra={"experts": args.experts, "ep": args.ep,
                    "top_k": args.top_k}) as telem:
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight) as pump:
-            for i, batch in zip(range(cfg.num_steps), pref):
+            for i, batch in zip(range(ctx.start_step, cfg.num_steps), pref):
+                if ctx.should_stop(i):
+                    break
                 shards, opt_state, loss = step(shards, opt_state, batch)
                 log = (lambda lf, i=i:
                        print(f"[train_moe] step {i:3d} loss {lf:.4f}")) \
                     if i % 5 == 0 or i == cfg.num_steps - 1 else None
-                pump.emit(loss,
-                          tokens=cfg.batch_size * cfg.sequence_length,
-                          log=log)
-    metrics = pump.metrics
+                synced = pump.emit(
+                    loss, tokens=cfg.batch_size * cfg.sequence_length,
+                    log=log)
+                ctx.after_step(i, synced, lambda i=i: RZ.RunState(
+                    params=shards, opt_state=opt_state, step=i,
+                    data_cursor=i + 1, prng_key=key,
+                    loss_log=ctx.full_losses(pump.losses)))
+        ctx.finalize(telem)
+    metrics = pump.metrics or {}
     print(f"[train_moe] host syncs: {pump.host_sync_count} "
           f"({pump.sync_breakdown})")
     if prof:
@@ -166,6 +197,7 @@ def main(argv=None):
               f"avg_loss {metrics.get('avg_loss', float('nan')):.4f}")
     if telem.run_dir:
         print(f"[train_moe] telemetry in {telem.run_dir}")
+    metrics["losses"] = ctx.full_losses(pump.losses)
     return metrics
 
 

@@ -157,6 +157,49 @@ def once(step, s, b):
     assert "host-sync-in-loop" not in _checks(lint_source(src))
 
 
+def test_ckpt_manager_without_wait_flagged():
+    src = """
+from distributed_training_sandbox_tpu.utils import checkpoint as C
+def train(state):
+    mgr = C.checkpoint_manager("/tmp/ck")
+    C.save_state(mgr, 0, state, wait=False)
+"""
+    found = [x for x in lint_source(src)
+             if x.check == "ckpt-manager-no-wait"]
+    assert [f.severity for f in found] == [SEV_ERROR]
+    assert "wait_until_finished" in found[0].message
+
+
+def test_ckpt_manager_with_guard_is_clean():
+    # any of: explicit wait, the closing() wrapper, or the resilience
+    # Checkpointer (which closes in a finally) counts as the guarantee
+    waited = """
+from distributed_training_sandbox_tpu.utils import checkpoint as C
+def train(state):
+    mgr = C.checkpoint_manager("/tmp/ck")
+    C.save_state(mgr, 0, state, wait=False)
+    mgr.wait_until_finished()
+"""
+    wrapped = """
+from distributed_training_sandbox_tpu.utils import checkpoint as C
+def train(state):
+    with C.closing(C.checkpoint_manager("/tmp/ck")) as mgr:
+        C.save_state(mgr, 0, state, wait=False)
+"""
+    for src in (waited, wrapped):
+        assert "ckpt-manager-no-wait" not in _checks(lint_source(src))
+
+
+def test_ckpt_ok_pragma_suppresses():
+    src = """
+from distributed_training_sandbox_tpu.utils import checkpoint as C
+def load(params):
+    mgr = C.checkpoint_manager("/tmp/ck")  # ckpt-ok: restore-only
+    return C.restore_state(mgr, like={"params": params})
+"""
+    assert "ckpt-manager-no-wait" not in _checks(lint_source(src))
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     p = tmp_path / "broken.py"
     p.write_text("def f(:\n")
