@@ -65,6 +65,14 @@ KNOB_MATRIX = [
     ("explicit_reshard_syncstep", {}, {"reshard_after_forward": True}, 1,
      {"sync_each_step": True}),
     ("explicit_noreshard", {}, {"reshard_after_forward": False}, 1),
+    # overlap engine A/B twins of explicit_reshard (identical knobs, the
+    # gathers ring-decomposed): "ring" = bitwise-identical ppermute-hop
+    # gathers; "ring_fused" = decomposed all_gather_matmul collective
+    # matmuls.  The explicit_reshard delta is recorded as "overlap_ab".
+    ("explicit_ring", {}, {"reshard_after_forward": True,
+                           "overlap": "ring"}, 1),
+    ("explicit_ring_fused", {}, {"reshard_after_forward": True,
+                                 "overlap": "ring_fused"}, 1),
     ("auto", {}, None, 1),                      # None -> pjit-auto variant
     ("explicit_save_attn", {"remat_policy": "save_attn"},
      {"reshard_after_forward": True}, 1),
@@ -349,6 +357,23 @@ def main():
         pump_ab = {"on": on, "off": off,
                    "speedup": round(off["step_ms"] / on["step_ms"], 3)
                    if on["step_ms"] else None}
+    # overlap engine A/B: monolithic gathers vs the ring decompositions
+    # at identical knobs/shapes.  step-time deltas here; the overlap-%
+    # deltas come from profiled telemetry runs via scripts/report.py's
+    # overlap columns (the bench loop doesn't trace).
+    overlap_ab = None
+    if "explicit_reshard" in by_cfg and (
+            {"explicit_ring", "explicit_ring_fused"} & set(by_cfg)):
+        base = by_cfg["explicit_reshard"]
+        overlap_ab = {"none": base}
+        for k in ("explicit_ring", "explicit_ring_fused"):
+            if k in by_cfg:
+                row = by_cfg[k]
+                mode = k.removeprefix("explicit_")
+                overlap_ab[mode] = row
+                overlap_ab[f"{mode}_speedup"] = (
+                    round(base["step_ms"] / row["step_ms"], 3)
+                    if row["step_ms"] else None)
     out = {
         "metric": "fsdp_train_tflops_per_device",
         "value": best["tflops_per_device"],
@@ -358,6 +383,7 @@ def main():
         "baseline": f"reference FSDP2 SmolLM3-3B seq8192 2xA100 "
                     f"{REF_TOK_S:.0f} tok/s = {ref:.1f} TFLOPS/device",
         "pump_ab": pump_ab,
+        "overlap_ab": overlap_ab,
         "checkpoint_overhead": ckpt_row,
         "matrix": matrix,
     }

@@ -114,6 +114,47 @@ def _ddp_bucketed_counts(c: ContractContext) -> dict:
     return {"all_reduce": n + 2}
 
 
+def _ddp_q8_counts(c: ContractContext) -> dict:
+    """int8 quantized grad sync: per flat bucket one all_gather of the
+    int8 codes + one of the f32 scale (the loss mean + barrier stay
+    all_reduces).  Bucket count = the same closed formula as
+    ddp_bucketed (capacity floors to whole elements of the ORIGINAL
+    grad dtype — quantization happens after bucketing)."""
+    import numpy as np
+    bucket_mb = float(c.extra.get("bucket_mb") or 25.0)
+    dtype_bytes = c.extra.get("dtype_bytes")
+    if dtype_bytes:
+        n = sum(ddp_bucket_count(b, bucket_mb, np.dtype(dt).itemsize)
+                for dt, b in dtype_bytes.items())
+    else:
+        n = ddp_bucket_count(c.param_bytes, bucket_mb)
+    return {"all_reduce": 2, "all_gather": 2 * n}
+
+
+def _fsdp_ring_counts(c: ContractContext) -> dict:
+    """fsdp with the gathers ring-decomposed: every all_gather site
+    becomes ws-1 collective_permute hops (rank-order chunk placement);
+    the backward stays the monolithic psum_scatter per leaf (pinned by
+    the ring op's custom_vjp, which is also what makes the variant
+    bitwise-identical).  Remat re-runs the forward ring in the backward
+    scan, hence the 2x upper bound."""
+    ws = c.axis_sizes.get("dp", c.ws)
+    hops = c.n_leaves * (ws - 1)
+    return {"all_reduce": 1, "reduce_scatter": c.n_leaves,
+            "collective_permute": (hops, 2 * hops)}
+
+
+def _tp_ring_counts(c: ContractContext) -> dict:
+    """tp with the two per-layer rejoin psums decomposed into
+    psum_scatter + ring all-gather: 2 reduce_scatter sites, tp-1 hops
+    each, and the rejoins' backward psums (custom_vjp) fold into the
+    same all_reduce budget the baseline's transposes used."""
+    tp = c.axis_sizes.get("tp", 2)
+    return {"all_reduce": (c.n_leaves, c.n_leaves + 6),
+            "reduce_scatter": 2,
+            "collective_permute": 2 * (tp - 1)}
+
+
 def _zero1_counts(c: ContractContext) -> dict:
     if c.extra.get("rebuild", "broadcast") == "all_gather":
         return {"all_reduce": c.n_leaves + 2, "all_gather": c.n_leaves}
@@ -144,6 +185,17 @@ CONTRACTS: dict[str, CollectiveContract] = {
         payload_bytes=lambda c: 2 * c.param_bytes,
         description="ceil(param_bytes/bucket) grad all_reduces over flat "
                     "buckets + loss mean + barrier; no gathers"),
+    # grads quantized to int8 in flat buckets, shipped as all_gathers of
+    # (codes, per-bucket scale) and summed after the wire — ~8x less bus
+    # traffic than the f32 all_reduce (EQuARX, arXiv:2506.17615)
+    "ddp_q8": CollectiveContract(
+        "ddp_q8", ("dp",), _ddp_q8_counts,
+        # int8 codes ride a gather (1x the quantized payload on the wire)
+        # vs the f32 all_reduce's 2x full payload
+        payload_bytes=lambda c: c.param_bytes // 4,
+        description="2 all_gathers (int8 codes + scale) per flat grad "
+                    "bucket + loss mean + barrier; no f32 all_reduces "
+                    "on the grad path"),
     # grads all_reduced per param, owner-chunk Adam, per-param rebuild
     "zero1": CollectiveContract(
         "zero1", ("dp",), _zero1_counts,
@@ -181,6 +233,25 @@ CONTRACTS: dict[str, CollectiveContract] = {
         payload_bytes=lambda c: 3 * c.param_bytes,
         description="one gather + one reduce-scatter site per param leaf "
                     "(scan collapses depth), one loss pmean"),
+    # fsdp with --overlap ring: the overlap engine's decomposed gathers
+    # (ops.collectives.ring_all_gather) — ppermute hops instead of
+    # monolithic all_gathers, bitwise-identical losses
+    "fsdp_ring": CollectiveContract(
+        "fsdp_ring", ("dp",), _fsdp_ring_counts,
+        allows_full_param_gather=True,
+        payload_bytes=lambda c: 3 * c.param_bytes,
+        description="(ws-1) ppermute hops per gathered leaf, monolithic "
+                    "psum_scatter backward per leaf, one loss pmean; "
+                    "any all_gather site is a fallback to the "
+                    "un-decomposed path"),
+    # tp with --overlap ring: the two per-layer rejoin psums decomposed
+    # into psum_scatter + ring all-gather (bitwise-identical)
+    "tp_ring": CollectiveContract(
+        "tp_ring", ("dp", "tp"), _tp_ring_counts,
+        payload_bytes=None,
+        description="2 rejoin psum_scatter sites + 2(tp-1) ppermute hops "
+                    "+ per-leaf grad psums; gather/scatter of params "
+                    "still forbidden"),
     # Megatron TP: activations psum'd in the layer body (2/layer-site),
     # grads psum'd per replicated leaf; NO param gathers or scatters —
     # an all_gather here means a param silently went dp-replicated.

@@ -18,8 +18,9 @@ from typing import Any, Callable
 
 from .contracts import CONTRACTS, ContractContext
 
-STRATEGIES = ("ddp", "ddp_bucketed", "zero1", "zero2", "zero3", "fsdp",
-              "tp", "sp", "moe", "gpipe", "1f1b")
+STRATEGIES = ("ddp", "ddp_bucketed", "ddp_q8", "zero1", "zero2", "zero3",
+              "fsdp", "fsdp_ring", "tp", "tp_ring", "sp", "moe", "gpipe",
+              "1f1b")
 
 # the canonical bucket size for the ddp_bucketed fixture — small enough
 # that the toy MLP needs several buckets, so the formula is exercised
@@ -77,7 +78,8 @@ def build_strategy(strategy: str, *, mesh=None, scale: int = 100,
     n_dev = len(jax.devices())
 
     # ---- toy-MLP strategies over a 1-D dp mesh -------------------------
-    if strategy in ("ddp", "ddp_bucketed", "zero1", "zero2", "zero3"):
+    if strategy in ("ddp", "ddp_bucketed", "ddp_q8", "zero1", "zero2",
+                    "zero3"):
         mesh = mesh or make_mesh(register=False)
         params = zero_toy_mlp(key, scale=scale)
         width = 10_000 // scale
@@ -86,16 +88,17 @@ def build_strategy(strategy: str, *, mesh=None, scale: int = 100,
              jax.random.normal(ky, (batch_size, width)))
         shapes = param_shapes(params, min_numel=256)
         extra = {"bucket_mb": FIXTURE_BUCKET_MB} \
-            if strategy == "ddp_bucketed" else {}
+            if strategy in ("ddp_bucketed", "ddp_q8") else {}
         ctx = ContractContext.capture(params=params, mesh=mesh,
                                       n_layers=len(params), **extra)
-        if strategy in ("ddp", "ddp_bucketed"):
+        if strategy in ("ddp", "ddp_bucketed", "ddp_q8"):
             step = make_ddp_train_step(
                 mse_loss,
                 lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3),
                 mesh, "dp",
                 bucket_mb=FIXTURE_BUCKET_MB
-                if strategy == "ddp_bucketed" else None)
+                if strategy in ("ddp_bucketed", "ddp_q8") else None,
+                quantize_grads=strategy == "ddp_q8")
             args = (params, optim.sgd_init(params), b)
         elif strategy in ("zero1", "zero2"):
             step = make_zero_train_step(mse_loss, mesh, "dp",
@@ -112,10 +115,10 @@ def build_strategy(strategy: str, *, mesh=None, scale: int = 100,
                              ctx, donate=True, full_param_shapes=shapes)
 
     # ---- transformer strategies ----------------------------------------
-    if strategy in ("fsdp", "tp", "sp", "moe"):
+    if strategy in ("fsdp", "fsdp_ring", "tp", "tp_ring", "sp", "moe"):
         mcfg = T.TINY_LM
-        second_axis = {"fsdp": None, "tp": "tp", "sp": "sp",
-                       "moe": "ep"}[strategy]
+        second_axis = {"fsdp": None, "fsdp_ring": None, "tp": "tp",
+                       "tp_ring": "tp", "sp": "sp", "moe": "ep"}[strategy]
         if mesh is None:
             if second_axis is None:
                 mesh = make_mesh(register=False)
@@ -133,15 +136,19 @@ def build_strategy(strategy: str, *, mesh=None, scale: int = 100,
         shapes = param_shapes(params, min_numel=1024)
         ctx = ContractContext.capture(params=params, mesh=mesh,
                                       n_layers=mcfg.num_hidden_layers)
-        if strategy == "fsdp":
+        if strategy in ("fsdp", "fsdp_ring"):
             shards = fsdp.shard_params_fsdp(params, mesh)
-            step = fsdp.make_fsdp_train_step(shards, mcfg, mesh)
+            step = fsdp.make_fsdp_train_step(
+                shards, mcfg, mesh,
+                overlap="ring" if strategy == "fsdp_ring" else "none")
         elif strategy == "sp":
             shards = fsdp.shard_params_fsdp(params, mesh, "dp")
             step = sequence.make_sp_train_step(shards, mcfg, mesh)
-        elif strategy == "tp":
+        elif strategy in ("tp", "tp_ring"):
             shards = tensor.shard_params_tp(params, mesh)
-            step = tensor.make_tp_train_step(shards, mcfg, mesh)
+            step = tensor.make_tp_train_step(
+                shards, mcfg, mesh,
+                overlap="ring" if strategy == "tp_ring" else "none")
         else:
             shards = expert.shard_moe_lm_params(params, mesh)
             step = expert.make_moe_lm_train_step(shards, mcfg, mesh)

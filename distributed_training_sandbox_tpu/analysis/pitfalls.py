@@ -37,6 +37,15 @@ traces it), tuned so the current ``scripts/`` tree is clean at the
     that never save) marks the call line — or the line above — with a
     ``ckpt-ok`` comment.
 
+  * ``gather-in-step`` (error) — a monolithic ``all_gather`` inside a
+    ``*step*`` function in a module that also has a ring variant in
+    scope (``ring_all_gather`` / ``all_gather_matmul``): the overlap
+    engine exists precisely so hot-path gathers decompose into
+    schedulable ppermute hops; a plain all_gather next to an available
+    ring twin is usually a missed ``overlap="ring"`` wiring, not a
+    choice.  A deliberate monolithic gather (e.g. the baseline leg of
+    an A/B) marks the line — or the line above — with ``# gather-ok``.
+
 Findings carry a severity; ``scripts/lint_sharding.py`` fails the run
 only on errors (``--strict`` promotes warnings).
 """
@@ -72,6 +81,10 @@ HOST_SYNC_FNS = {"block_until_ready", "local_scalar"}
 CKPT_OPENERS = {"checkpoint_manager", "CheckpointManager"}
 CKPT_GUARDS = {"wait_until_finished", "closing", "Checkpointer",
                "Supervisor"}
+# names whose presence anywhere in the file means a ring-decomposed
+# gather is available — a monolithic all_gather in a *step* function is
+# then flagged (the overlap-engine wiring lint)
+RING_VARIANTS = {"ring_all_gather", "all_gather_matmul"}
 
 SEV_ERROR = "error"
 SEV_WARN = "warn"
@@ -124,23 +137,36 @@ class _Visitor(ast.NodeVisitor):
         self.findings: list[PitfallFinding] = []
         self._loop_depth = 0
         self._jit_depth = 0
+        self._fn_stack: list[str] = []
         self.uses_shard_wrapper = False
         self.collective_calls: list[tuple[int, str]] = []
         self.ckpt_opens: list[tuple[int, str]] = []
         self.has_ckpt_guard = False
+        self.has_ring_variant = False
+        self.gathers_in_step: list[tuple[int, str]] = []
 
     # -- context tracking -------------------------------------------------
     def _visit_function(self, node):
         jitted = _has_jit_decorator(node)
         self._jit_depth += jitted
+        self._fn_stack.append(node.name)
         # a nested function starts a fresh loop context: a closure built
         # inside a loop body does not itself run per-iteration
         saved, self._loop_depth = self._loop_depth, 0
         self.generic_visit(node)
         self._loop_depth = saved
+        self._fn_stack.pop()
         self._jit_depth -= jitted
 
     visit_FunctionDef = visit_AsyncFunctionDef = _visit_function
+
+    def _visit_import(self, node):
+        for alias in node.names:
+            if alias.name.rsplit(".", 1)[-1] in RING_VARIANTS \
+                    or (alias.asname or "") in RING_VARIANTS:
+                self.has_ring_variant = True
+
+    visit_Import = visit_ImportFrom = _visit_import
 
     def _visit_loop(self, node):
         self._loop_depth += 1
@@ -167,6 +193,11 @@ class _Visitor(ast.NodeVisitor):
         if (leaf in COLLECTIVE_FNS
                 and root in ("lax", "jax", "C", "collectives")):
             self.collective_calls.append((node.lineno, chain))
+            if (leaf == "all_gather"
+                    and any("step" in n.lower() for n in self._fn_stack)):
+                self.gathers_in_step.append((node.lineno, chain))
+        if leaf in RING_VARIANTS:
+            self.has_ring_variant = True
         if leaf in CKPT_OPENERS:
             self.ckpt_opens.append((node.lineno, chain))
         if leaf in CKPT_GUARDS:
@@ -204,12 +235,16 @@ class _Visitor(ast.NodeVisitor):
             self.uses_shard_wrapper = True
         if node.id in CKPT_GUARDS:
             self.has_ckpt_guard = True
+        if node.id in RING_VARIANTS:
+            self.has_ring_variant = True
 
     def visit_Attribute(self, node: ast.Attribute):
         if node.attr in SHARD_WRAPPERS:
             self.uses_shard_wrapper = True
         if node.attr in CKPT_GUARDS:
             self.has_ckpt_guard = True
+        if node.attr in RING_VARIANTS:
+            self.has_ring_variant = True
         self.generic_visit(node)
 
     def _check_donation(self, node: ast.Call):
@@ -268,6 +303,18 @@ def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
                 f"manager in utils.checkpoint.closing(...) (or use "
                 f"resilience.Checkpointer), or mark a restore-only "
                 f"open with '# ckpt-ok'"))
+    if v.has_ring_variant:
+        for line, chain in v.gathers_in_step:
+            if _pragma(line, "gather-ok"):
+                continue
+            findings.append(PitfallFinding(
+                path, line, "gather-in-step", SEV_ERROR,
+                f"{chain}() inside a *step* function while a ring "
+                f"variant (ring_all_gather / all_gather_matmul) is in "
+                f"scope in this module — decompose the hot-path gather "
+                f"(overlap='ring') so its hops can hide behind compute, "
+                f"or mark a deliberate monolithic gather with "
+                f"'# gather-ok'"))
     if v.collective_calls and not v.uses_shard_wrapper:
         line, chain = v.collective_calls[0]
         findings.append(PitfallFinding(

@@ -394,12 +394,25 @@ def _dense(cfg: TransformerConfig):
     without ``jax.checkpoint`` nothing is saved, so the round-trip
     would be pure noise+cost) every output makes the int8 save
     round-trip (``quant.quantized_residual``) so the remat policy keeps
-    the int8 pair instead of the bf16 tensor."""
+    the int8 pair instead of the bf16 tensor.
+
+    A weight arriving as :class:`ops.collectives.RingShard` (the
+    ``overlap="ring_fused"`` FSDP layer hook leaves projection weights
+    sharded along their contraction dim) routes through the decomposed
+    collective matmul ``all_gather_matmul`` — gather hops interleaved
+    with the chunk matmuls instead of a monolithic gather-then-dot."""
+    from ..ops import collectives as C
     from ..ops.quant import quantized_residual, resolve_quantized_dense
     base = resolve_quantized_dense(cfg.matmul_precision)
+
+    def dispatch(a, w):
+        if isinstance(w, C.RingShard):
+            return C.all_gather_matmul(a, w.shard, w.axis_name)
+        return base(a, w)
+
     if cfg.remat and cfg.remat_policy == "save_dots_q8":
-        return lambda a, w: quantized_residual(base(a, w))
-    return base
+        return lambda a, w: quantized_residual(dispatch(a, w))
+    return dispatch
 
 
 def _qkv_proj(r, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
@@ -446,14 +459,20 @@ def _mlp_block(r, layer, *, cfg: TransformerConfig):
 
 
 def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
-                tp_axis: str | None = None):
+                tp_axis: str | None = None, tp_overlap: str = "none"):
     """One decoder layer.  ``layer`` holds this layer's (unstacked) params;
     ``use_rope`` is a traced bool scalar (NoPE schedule).
 
     ``tp_axis``: Megatron tensor parallelism (parallel/tensor.py) — the
     layer weights are LOCAL shards (wq/wk/wv/w_gate/w_up column-sharded,
     wo/w_down row-sharded over that mesh axis) and the two row-parallel
-    outputs are psum'd back into the residual stream."""
+    outputs are psum'd back into the residual stream.
+
+    ``tp_overlap="ring"`` decomposes those two psums into
+    psum_scatter + ring all-gather (``ops.collectives.
+    decomposed_all_reduce`` over the hidden dim) — bitwise-identical
+    values/grads, but the rejoin exposes tp-1 schedulable hops instead
+    of one monolithic all-reduce."""
     B, S, h = x.shape
     hd = cfg.resolved_head_dim
     tp = axis_size(tp_axis) if tp_axis else 1
@@ -479,8 +498,11 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     if tp_axis:  # Megatron f/g: rejoin the row-parallel partial sums
         from ..ops import collectives as C
         from ..utils.profiling import scope
+        _rejoin = ((lambda v: C.decomposed_all_reduce(v, tp_axis, axis=-1))
+                   if tp_overlap == "ring"
+                   else (lambda v: C.all_reduce(v, tp_axis)))
         with scope("tp_attn_psum"):
-            attn_out = C.all_reduce(attn_out, tp_axis)
+            attn_out = _rejoin(attn_out)
     x = x + attn_out
 
     r = rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
@@ -495,7 +517,7 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     mlp, aux = _mlp_block(r, layer, cfg=cfg)
     if tp_axis:
         with scope("tp_moe_psum" if cfg.n_experts else "tp_mlp_psum"):
-            mlp = C.all_reduce(mlp, tp_axis)
+            mlp = _rejoin(mlp)
     return x + mlp, aux
 
 

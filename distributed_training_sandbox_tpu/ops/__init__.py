@@ -12,6 +12,11 @@ from .collectives import (  # noqa: F401
     smap,
     tree_all_reduce,
     tree_all_gather,
+    ring_all_gather,
+    all_gather_matmul,
+    matmul_reduce_scatter,
+    decomposed_all_reduce,
+    RingShard,
 )
 from .hlo import count_collectives, lowered_text  # noqa: F401
 from . import quant  # noqa: F401

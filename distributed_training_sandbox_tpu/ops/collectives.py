@@ -146,6 +146,216 @@ def all_to_all(x, axis_name: str, *, split_axis: int = 0, concat_axis: int = 0,
                           concat_axis=concat_axis, tiled=tiled)
 
 
+# ------------------------------------------------------- ring decomposition
+#
+# The overlap engine's primitives (SimpleFSDP, arXiv:2411.00284): the same
+# bytes the monolithic all_gather / psum_scatter / psum ops move, but
+# decomposed into ppermute ring hops the XLA scheduler can interleave with
+# compute — a monolithic collective is an opaque wall; n-1 hops with a
+# matmul chunk between each are a pipeline.  Two exactness classes:
+#
+#   * ``ring_all_gather`` and ``decomposed_all_reduce`` are BITWISE equal
+#     to their monolithic twins: the ring moves data without arithmetic
+#     (chunks land in rank order), the reduction arithmetic stays in the
+#     monolithic psum_scatter (same per-element reduction order as psum —
+#     pinned by tests/test_overlap.py), and their custom_vjp backward IS
+#     the monolithic op's transpose.  These power ``--overlap ring``,
+#     whose loss sequences are bitwise-identical to ``--overlap none``.
+#   * ``all_gather_matmul`` / ``matmul_reduce_scatter`` additionally fuse
+#     the matmul into the ring (multiply the chunk already on device
+#     while the next shard travels / scatter partial products as they
+#     finish).  Chunked contraction reassociates the K-sum, so these are
+#     numerically equivalent but NOT bitwise — they power
+#     ``--overlap ring_fused``.
+
+
+class RingShard:
+    """A weight that stays SHARDED along its contraction dim: the marker
+    ``parallel.fsdp``'s ring_fused layer hook hands to the model so the
+    projection matmul runs as ``all_gather_matmul`` instead of
+    gather-then-matmul.  Registered as a pytree so it rides through scan
+    / remat / AD like the plain array it replaces."""
+
+    def __init__(self, shard, axis_name: str):
+        self.shard = shard
+        self.axis_name = axis_name
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"RingShard({self.shard.shape}, axis={self.axis_name!r})"
+
+
+jax.tree_util.register_pytree_node(
+    RingShard,
+    lambda rs: ((rs.shard,), rs.axis_name),
+    lambda axis_name, children: RingShard(children[0], axis_name))
+
+
+def _ring_perm(n: int, shift: int = 1):
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def _check_chunk(name: str, what: str, size: int, n: int, axis_name: str):
+    """Explicit divisibility guard: the ring splits ``what`` into one
+    chunk per device, so an indivisible dim would otherwise surface as an
+    opaque reshape/dynamic-slice failure deep in the trace."""
+    if size % n:
+        raise ValueError(
+            f"{name}: {what} of size {size} is not divisible by mesh "
+            f"axis {axis_name!r} size {n} — the ring needs one "
+            f"equal chunk per device (pad the dim or use the "
+            f"monolithic collective)")
+
+
+def _ring_gather_impl(x, axis_name: str, axis: int):
+    """n-1 ppermute hops assembling shards in rank order — value-wise
+    identical to ``lax.all_gather(tiled=True)`` (pure data movement)."""
+    n = axis_size(axis_name)
+    if n == 1:  # degenerate ring: nothing to gather
+        return x
+    idx = lax.axis_index(axis_name)
+    axis = axis % x.ndim
+    chunk = x.shape[axis]
+    out = jnp.zeros(x.shape[:axis] + (n * chunk,) + x.shape[axis + 1:],
+                    x.dtype)
+    cur = x
+    for t in range(n):
+        src = (idx - t) % n          # whose shard arrived after t hops
+        out = lax.dynamic_update_slice_in_dim(out, cur, src * chunk, axis)
+        if t < n - 1:
+            cur = lax.ppermute(cur, axis_name, _ring_perm(n))
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def ring_all_gather(x, axis_name: str, axis: int = 0):
+    """Ring-decomposed twin of :func:`all_gather`: bitwise-identical
+    output (rank-order chunk placement, zero arithmetic), backward pinned
+    to the monolithic gather's transpose (one psum_scatter) so gradients
+    are bitwise-identical too.  The n-1 exposed hops are what the
+    latency-hiding scheduler overlaps with the compute consuming the
+    early chunks."""
+    return _ring_gather_impl(x, axis_name, axis)
+
+
+def _rag_fwd(x, axis_name, axis):
+    return _ring_gather_impl(x, axis_name, axis), None
+
+
+def _rag_bwd(axis_name, axis, _res, g):
+    if axis_size(axis_name) == 1:
+        return (g,)
+    return (lax.psum_scatter(g, axis_name, scatter_dimension=axis % g.ndim,
+                             tiled=True),)
+
+
+ring_all_gather.defvjp(_rag_fwd, _rag_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def decomposed_all_reduce(x, axis_name: str, axis: int = -1):
+    """all_reduce split into psum_scatter + ring all-gather — the RS+AG
+    identity EQuARX treats as first-class.  The reduction arithmetic
+    stays in the monolithic psum_scatter (same per-element order as
+    lax.psum — pinned by test), the re-assembly is the exact ring, so
+    the value is BITWISE equal to ``lax.psum`` while exposing n-1
+    schedulable hops.  Backward is pinned to psum's own transpose
+    (a psum of the cotangent).  ``axis``: the dim to scatter over; must
+    be divisible by the ring size."""
+    n = axis_size(axis_name)
+    if n == 1:
+        return x
+    axis = axis % x.ndim
+    _check_chunk("decomposed_all_reduce", f"scatter dim {axis}",
+                 x.shape[axis], n, axis_name)
+    scattered = lax.psum_scatter(x, axis_name, scatter_dimension=axis,
+                                 tiled=True)
+    return _ring_gather_impl(scattered, axis_name, axis)
+
+
+def _dar_fwd(x, axis_name, axis):
+    return decomposed_all_reduce(x, axis_name, axis), None
+
+
+def _dar_bwd(axis_name, axis, _res, g):
+    # lax.psum transposes to lax.psum (replicated cotangent summed) —
+    # keep the ring variant's backward identical to the baseline's
+    return (lax.psum(g, axis_name),)
+
+
+decomposed_all_reduce.defvjp(_dar_fwd, _dar_bwd)
+
+
+def all_gather_matmul(a, w_shard, axis_name: str):
+    """Decomposed collective matmul, gather side: ``a @ W`` where ``W``
+    is the rank-order concatenation of ``w_shard`` (each device's rows
+    of the contraction dim).  At ring step t the chunk already on device
+    multiplies while the next shard travels — the all-gather never
+    materializes as one op, so nothing blocks the MXU.
+
+    Plain traceable code: its AD transpose IS the ring
+    matmul-reduce-scatter (cotangent contributions ride the reversed
+    ring and sum along the way), which is why the ring_fused FSDP
+    backward needs no separate reduce-scatter.  Chunked contraction
+    reassociates the K-sum: numerically equivalent, not bitwise.
+    """
+    n = axis_size(axis_name)
+    if n == 1:   # degenerate ring: the shard IS the whole weight
+        return a @ w_shard
+    k_chunk = w_shard.shape[0]
+    K = a.shape[-1]
+    if K != n * k_chunk:
+        raise ValueError(
+            f"all_gather_matmul: activation contraction dim {K} != "
+            f"mesh axis {axis_name!r} size {n} x weight shard rows "
+            f"{k_chunk} — the shard must be a 1/{n} row-slice of the "
+            f"full weight (got shard shape {tuple(w_shard.shape)})")
+    idx = lax.axis_index(axis_name)
+    acc = jnp.zeros(a.shape[:-1] + (w_shard.shape[1],),
+                    jnp.promote_types(a.dtype, w_shard.dtype))
+    cur = w_shard
+    for t in range(n):
+        src = (idx - t) % n
+        a_chunk = lax.dynamic_slice_in_dim(a, src * k_chunk, k_chunk,
+                                           axis=a.ndim - 1)
+        acc = acc + a_chunk @ cur
+        if t < n - 1:
+            cur = lax.ppermute(cur, axis_name, _ring_perm(n))
+    return acc.astype(a.dtype)
+
+
+def matmul_reduce_scatter(a, b, axis_name: str, *, axis: int = 0):
+    """Decomposed collective matmul, scatter side:
+    ``psum_scatter(a @ b, axis)`` with each row-chunk's partial product
+    computed right before its traveling accumulator needs it — partial
+    products scatter as they finish instead of waiting for the full
+    matmul then the full reduce-scatter.  Ring accumulation reassociates
+    the device sum: numerically equivalent to the monolithic form, not
+    bitwise."""
+    n = axis_size(axis_name)
+    if n == 1:
+        return a @ b
+    axis = axis % a.ndim
+    if axis != 0:
+        raise ValueError("matmul_reduce_scatter: only axis=0 (row chunks "
+                         "of the result) is supported")
+    _check_chunk("matmul_reduce_scatter", "result row dim", a.shape[0],
+                 n, axis_name)
+    idx = lax.axis_index(axis_name)
+    chunk = a.shape[0] // n
+
+    def partial_product(c):
+        rows = lax.dynamic_slice_in_dim(a, c * chunk, chunk, axis=0)
+        return rows @ b
+
+    # accumulator for chunk (idx - s - 1) at step s lands fully summed on
+    # its owner after n-1 hops (derivation: f(d, s) = d - s - 1 mod n)
+    acc = partial_product((idx - 1) % n)
+    for s in range(1, n):
+        acc = lax.ppermute(acc, axis_name, _ring_perm(n))
+        acc = acc + partial_product((idx - s - 1) % n)
+    return acc
+
+
 def barrier(axis_name: str):
     """Step-isolation barrier: a 1-element psum, exactly what
     ``dist.barrier`` is under NCCL (reference README.md:11-12,

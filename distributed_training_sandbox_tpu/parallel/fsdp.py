@@ -159,21 +159,74 @@ def init_fsdp_opt_state(params_sharded, state_dtype=None):
 
 # ---------------------------------------------------------------- explicit
 
-def _gather_leaf(x, spec: P, axis: str, quantized: bool = False):
+OVERLAP_MODES = ("none", "ring", "ring_fused")
+
+
+def _gather_leaf(x, spec: P, axis: str, quantized: bool = False,
+                 overlap: str = "none", fuse_matmul: bool = False):
     """all_gather a shard back to full size along its sharded dim (no-op for
     leaves this axis doesn't shard).  ``quantized``: ship int8 + scales
     over the wire and dequantize after (the torchao fp8-all-gather twin,
     reference ``fp8/fp8_benchmark.py:79-81``).  Like torchao — which only
     low-precision-casts Linear weights — 1-D leaves (RMSNorm scales) stay
     in full precision: quantizing them saves negligible bandwidth and costs
-    outsized numerics."""
+    outsized numerics.
+
+    ``overlap="ring"``: the gather runs as the ppermute ring
+    (``C.ring_all_gather``) — bitwise-identical values and grads, but
+    n-1 schedulable hops instead of one monolithic collective.
+    ``fuse_matmul`` (ring_fused mode, layer-hook leaves only): a 2-D
+    projection weight sharded along its contraction dim is NOT gathered —
+    it returns as a :class:`C.RingShard` and the model's projection
+    matmul runs it as the decomposed ``all_gather_matmul``."""
     for dim, name in enumerate(spec):
         if name == axis:
             if quantized and x.ndim > 1:
                 from ..ops.quant import quantized_all_gather
                 return quantized_all_gather(x, axis, dim)
+            if fuse_matmul and x.ndim == 2 and dim == 0:
+                return C.RingShard(x, axis)
+            if overlap in ("ring", "ring_fused"):
+                return C.ring_all_gather(x, axis, dim)
             return C.all_gather(x, axis, axis=dim)
     return x
+
+
+def microbatch_value_and_grad(loss_fn, params, batch, accum_steps: int):
+    """Gradient accumulation over ``accum_steps`` microbatches:
+    ``lax.scan`` over the leading-dim split of ``batch``, value_and_grad
+    per microbatch, grads summed into a donated scan carry, one final
+    /accum_steps — the per-microbatch collectives (FSDP gathers, TP
+    rejoins, their transposes) then pipeline against the next
+    microbatch's compute instead of arriving as one end-of-step burst.
+    Remat-aware: each microbatch's forward re-runs under the model's own
+    ``jax.checkpoint`` policy inside ``loss_fn``, so only one
+    microbatch's activations (at the configured remat granularity) are
+    ever live.  Returns ``(mean_loss, mean_grads)`` — identical to one
+    full-batch step up to fp re-association of the batch reduction
+    (pinned tight by tests/test_overlap.py)."""
+    if accum_steps == 1:
+        return jax.value_and_grad(loss_fn)(params, batch)
+    B = jax.tree.leaves(batch)[0].shape[0]
+    if B % accum_steps:
+        raise ValueError(
+            f"accum_steps={accum_steps} must divide the per-device "
+            f"batch {B} (global batch / dp axis size)")
+    micro = jax.tree.map(
+        lambda t: t.reshape(accum_steps, B // accum_steps, *t.shape[1:]),
+        batch)
+
+    def body(carry, mbatch):
+        g_acc, l_acc = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params, mbatch)
+        return (jax.tree.map(jnp.add, g_acc, grads),
+                l_acc + loss.astype(jnp.float32)), None
+
+    init = (jax.tree.map(jnp.zeros_like, params),
+            jnp.zeros((), jnp.float32))
+    (g_sum, l_sum), _ = jax.lax.scan(body, init, micro)
+    return (l_sum / accum_steps,
+            jax.tree.map(lambda g: g / accum_steps, g_sum))
 
 
 def make_fsdp_train_step(
@@ -184,6 +237,8 @@ def make_fsdp_train_step(
     *,
     reshard_after_forward: bool = True,
     quantized_gather: bool = False,
+    overlap: str = "none",
+    accum_steps: int = 1,
     sp_axis: str | None = None,
     lr: float = 3e-4,
     lr_schedule: Callable | None = None,
@@ -215,8 +270,44 @@ def make_fsdp_train_step(
     ``init_fsdp_opt_state``) or "int8" (``init_fsdp_opt_state8`` /
     ``optim8.adam8_update`` — int8-at-rest moments, ~half the largest
     resident block; pass the matching opt state).
+
+    ``overlap`` (the overlap engine, SimpleFSDP arXiv:2411.00284):
+    "none" = monolithic per-leaf all_gathers; "ring" = the same gathers
+    decomposed into ppermute ring hops (bitwise-identical losses/grads —
+    the backward is pinned to the monolithic psum_scatter transpose);
+    "ring_fused" = 2-D projection weights stay sharded and their matmuls
+    run as decomposed ``all_gather_matmul`` collective matmuls
+    (numerically equivalent, not bitwise: the chunked contraction
+    re-associates the K-sum).  ring_fused requires the per-layer gather
+    seam (reshard_after_forward=True), a dense model, and full-precision
+    gathers.
+
+    ``accum_steps``: microbatched gradient accumulation —
+    ``lax.scan`` over accum_steps splits of the batch with a donated
+    grad carry (see :func:`microbatch_value_and_grad`); must divide the
+    per-device batch.
     """
     ws = int(mesh.shape[axis])
+    if overlap not in OVERLAP_MODES:
+        raise ValueError(f"overlap={overlap!r}; choose from "
+                         f"{OVERLAP_MODES}")
+    if overlap == "ring_fused":
+        if quantized_gather:
+            raise ValueError("overlap='ring_fused' fuses full-precision "
+                             "collective matmuls; it does not compose "
+                             "with quantized_gather (use overlap='ring')")
+        if not reshard_after_forward:
+            raise ValueError("overlap='ring_fused' needs the per-layer "
+                             "gather seam — reshard_after_forward=False "
+                             "keeps gathered weights live, which "
+                             "contradicts fused re-ringing")
+        if getattr(cfg, "n_experts", 0):
+            raise ValueError("overlap='ring_fused' covers dense "
+                             "projection leaves only; MoE expert leaves "
+                             "shard their expert dim, not a contraction "
+                             "dim (use overlap='ring')")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if sp_axis is not None:
         cfg = dataclasses.replace(cfg, attention_impl="ring",
                                   sp_axis=sp_axis)
@@ -238,18 +329,24 @@ def make_fsdp_train_step(
     hook_specs = jax.tree.map(lambda s: P(*s[1:]), layer_specs,
                               is_leaf=lambda x: isinstance(x, P))
 
+    fuse = overlap == "ring_fused"
+
     def layer_hook(layer):
         with scope("fsdp_layer_gather"):
             return _spec_map(
-                lambda x, s: _gather_leaf(x, s, axis, quantized_gather),
+                lambda x, s: _gather_leaf(x, s, axis, quantized_gather,
+                                          overlap, fuse_matmul=fuse),
                 layer, hook_specs)
 
     def step(shards, opt_state, batch):
         def sharded_loss(shards, batch):
             # Root group: embed / final_norm / lm_head gathered up front
             # (the root fully_shard wrap, reference train_fsdp.py:94).
+            # Never matmul-fused: embed is a lookup table, not a
+            # projection operand.
             with scope("fsdp_root_gather"):
-                outer = {k: _gather_leaf(v, specs[k], axis, quantized_gather)
+                outer = {k: _gather_leaf(v, specs[k], axis,
+                                         quantized_gather, overlap)
                          for k, v in shards.items() if k != "layers"}
             if reshard_after_forward:
                 params = {**outer, "layers": shards["layers"]}
@@ -259,16 +356,19 @@ def make_fsdp_train_step(
             # 1849 tok/s knob, train_fsdp.py:85-86).
             with scope("fsdp_pre_gather_layers"):
                 full_layers = _spec_map(
-                    lambda x, s: _gather_leaf(x, s, axis, quantized_gather),
+                    lambda x, s: _gather_leaf(x, s, axis,
+                                              quantized_gather, overlap),
                     shards["layers"], layer_specs)
             params = {**outer, "layers": full_layers}
             return base_loss(params, batch, cfg, layer_hook=None)
 
         with scope("forward_backward"):
             # Grads w.r.t. the SHARDS: each all_gather transposes to a
-            # psum_scatter — the FSDP backward reduce-scatter.
-            loss, grad_shards = jax.value_and_grad(sharded_loss)(
-                shards, batch)
+            # psum_scatter — the FSDP backward reduce-scatter.  With
+            # accum_steps > 1 the scan's per-microbatch transposes
+            # pipeline against the next microbatch's forward.
+            loss, grad_shards = microbatch_value_and_grad(
+                sharded_loss, shards, batch, accum_steps)
         with scope("loss_mean"):
             loss = C.all_reduce(loss, axis, mean=True)
             if sp_axis is not None:

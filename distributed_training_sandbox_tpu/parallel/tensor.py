@@ -82,7 +82,7 @@ def shard_params_tp(params, mesh: Mesh, axis: str = "tp"):
 
 
 def tp_lm_loss(params, batch, cfg: T.TransformerConfig, *,
-               axis: str = "tp") -> jax.Array:
+               axis: str = "tp", overlap: str = "none") -> jax.Array:
     """Causal-LM loss with Megatron TP layers (shard_map only): the
     shared decoder body (``transformer._layer_body``) runs with
     ``tp_axis`` set — local head/intermediate shards, two psums per layer
@@ -93,10 +93,14 @@ def tp_lm_loss(params, batch, cfg: T.TransformerConfig, *,
     Composes with sequence parallelism: with ``cfg.sp_axis`` set (ring
     attention), each device holds its tp-share of heads AND its sp-chunk
     of the sequence — the KV ring circulates over ``sp_axis`` within
-    each tp group, carrying only the local heads."""
+    each tp group, carrying only the local heads.
+
+    ``overlap="ring"`` decomposes the two per-layer row-parallel rejoin
+    psums into psum_scatter + ring all-gather (bitwise-identical — see
+    ``ops.collectives.decomposed_all_reduce``)."""
     import functools
     return T.lm_loss(params, batch, cfg, layer_body=functools.partial(
-        T._layer_body, tp_axis=axis))
+        T._layer_body, tp_axis=axis, tp_overlap=overlap))
 
 
 def make_tp_train_step(
@@ -107,6 +111,8 @@ def make_tp_train_step(
     dp_axis: str = "dp",
     tp_axis: str = "tp",
     sp_axis: str | None = None,
+    overlap: str = "none",
+    accum_steps: int = 1,
     lr: float = 3e-4,
     b1: float = 0.9,
     b2: float = 0.95,
@@ -122,10 +128,26 @@ def make_tp_train_step(
 
     ``sp_axis`` makes it the full 3-D dp×sp×tp step: the batch's
     sequence dim shards over ``sp_axis`` and attention becomes the KV
-    ring over it (carrying only this device's tp-share of heads)."""
+    ring over it (carrying only this device's tp-share of heads).
+
+    ``overlap="ring"``: the per-layer row-parallel rejoin psums run
+    decomposed (psum_scatter + ring all-gather) — bitwise-identical
+    loss/grads, tp-1 schedulable hops per rejoin.  Applies to the
+    default ``tp_lm_loss`` only (a custom ``loss_fn`` owns its own
+    collectives).  ``accum_steps``: microbatched gradient accumulation
+    over leading-dim batch splits (``fsdp.microbatch_value_and_grad``)."""
     ws_dp = int(mesh.shape[dp_axis])
     ws_tp = int(mesh.shape[tp_axis])
     check_tp_divisibility(cfg, ws_tp)
+    if overlap not in ("none", "ring"):
+        raise ValueError(f"overlap={overlap!r}; the tp step supports "
+                         f"'none' or 'ring'")
+    if overlap == "ring" and loss_fn is not None:
+        raise ValueError("overlap='ring' rewires tp_lm_loss's rejoin "
+                         "psums; a custom loss_fn owns its own "
+                         "collectives — decompose them there instead")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if sp_axis is None and cfg.sp_axis is not None:
         raise ValueError(
             f"cfg.sp_axis={cfg.sp_axis!r} (ring attention) but "
@@ -144,7 +166,8 @@ def make_tp_train_step(
     # a loss that declares an ``axis`` parameter (like tp_lm_loss) gets
     # the tp axis forwarded.
     if loss_fn is None:
-        base_loss = lambda p, b, c: tp_lm_loss(p, b, c, axis=tp_axis)
+        base_loss = lambda p, b, c: tp_lm_loss(p, b, c, axis=tp_axis,
+                                               overlap=overlap)
     else:
         import inspect
         if "axis" in inspect.signature(loss_fn).parameters:
@@ -163,8 +186,10 @@ def make_tp_train_step(
 
     def step(shards, opt_state, batch):
         with scope("forward_backward"):
-            loss, grads = jax.value_and_grad(
-                lambda p: base_loss(p, batch, cfg))(shards)
+            from .fsdp import microbatch_value_and_grad
+            loss, grads = microbatch_value_and_grad(
+                lambda p, b: base_loss(p, b, cfg), shards, batch,
+                accum_steps)
         with scope("loss_mean"):
             # one fused mean over every axis (tp ranks hold identical
             # losses; including tp re-establishes replication for the

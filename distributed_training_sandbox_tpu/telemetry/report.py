@@ -327,6 +327,65 @@ def check_regressions(current: list[dict], baseline: list[dict],
     return results
 
 
+def check_overlap_regressions(current: list[dict], baseline: list[dict],
+                              max_drop_pp: float = 5.0) -> list[dict]:
+    """Overlap A/B between comparable rows: for every (current, baseline)
+    pair that :func:`_match` accepts and where BOTH carry
+    ``overlap_fraction`` (the comm-concurrent-with-compute share from
+    ``trace_analysis.CommSplit``), record the overlap delta in
+    PERCENTAGE POINTS alongside the step-time delta, flagging
+    ``regressed`` when overlap dropped by more than ``max_drop_pp`` pp —
+    the CI gate behind ``report.py --fail-on-overlap-regression``."""
+    results = []
+    for cur in current:
+        for base in baseline:
+            if cur is base or not _match(cur, base):
+                continue
+            a, b = cur.get("overlap_fraction"), base.get("overlap_fraction")
+            if a is None or b is None:
+                continue
+            delta_pp = (float(a) - float(b)) * 100.0
+            st_cur, st_base = cur.get("step_time_ms"), \
+                base.get("step_time_ms")
+            step_delta = (st_cur / st_base - 1.0
+                          if st_cur and st_base else None)
+            results.append({
+                "run_id": cur.get("run_id"),
+                "baseline": base.get("run_id") or base.get("config")
+                or base.get("strategy"),
+                "overlap_pct": 100.0 * float(a),
+                "baseline_overlap_pct": 100.0 * float(b),
+                "overlap_delta_pp": delta_pp,
+                "step_time_ms": st_cur,
+                "baseline_step_time_ms": st_base,
+                "step_time_delta": step_delta,
+                "max_drop_pp": max_drop_pp,
+                "regressed": delta_pp < -max_drop_pp,
+            })
+    return results
+
+
+def render_overlap_deltas(results: list[dict]) -> str:
+    if not results:
+        return "_no comparable rows carry overlap data (profile-enabled " \
+               "runs write comm_split.overlap_fraction into summary.json)_"
+    out = ["| run | baseline | overlap % | base overlap % | Δ pp | "
+           "step ms | base step ms | Δ step | verdict |",
+           "|---|---|---|---|---|---|---|---|---|"]
+    for r in results:
+        sd = r.get("step_time_delta")
+        out.append(
+            f"| {r['run_id']} | {r['baseline']} "
+            f"| {_fmt(r['overlap_pct'], '.1f')} "
+            f"| {_fmt(r['baseline_overlap_pct'], '.1f')} "
+            f"| {r['overlap_delta_pp']:+.1f} "
+            f"| {_fmt(r.get('step_time_ms'), '.2f')} "
+            f"| {_fmt(r.get('baseline_step_time_ms'), '.2f')} "
+            f"| {f'{sd:+.1%}' if sd is not None else '—'} "
+            f"| {'REGRESSED' if r['regressed'] else 'ok'} |")
+    return "\n".join(out)
+
+
 def render_regressions(results: list[dict]) -> str:
     if not results:
         return "_no comparable baseline rows_"

@@ -54,6 +54,23 @@ class TrainConfig:
     sync_every: int = 10         # barrier every N steps (0 = exit only)
     max_in_flight: int = 16      # bounded dispatch window (backpressure)
     bucket_mb: float | None = None  # ddp: all-reduce grads in ~N MB buckets
+    # --- overlap engine (ops/collectives.py ring decomposition) ----------
+    # overlap: "ring" decomposes the strategy's hot-path collectives into
+    # ppermute ring hops the scheduler can hide behind compute — bitwise-
+    # identical losses to "none" (fsdp gathers / tp rejoin psums);
+    # "ring_fused" (fsdp only) additionally fuses the gather into the
+    # projection matmuls (all_gather_matmul — numerically equivalent,
+    # not bitwise).
+    overlap: str = "none"
+    # accum_steps: microbatched gradient accumulation — lax.scan over k
+    # splits of the batch with a donated grad carry; per-microbatch
+    # collectives pipeline against the next microbatch's compute.
+    accum_steps: int = 1
+    # quantize_grads: ddp int8 bucketed grad sync (ddp_q8 choreography);
+    # error_feedback threads the EF residual so quantization error is
+    # re-applied next step instead of compounding.
+    quantize_grads: bool = False
+    error_feedback: bool = False
     # --- resilience runtime (resilience/) --------------------------------
     # checkpoint_dir: RunState checkpoints (params + opt + PRNG root +
     # data cursor + loss log) land here; checkpoint_every=N saves async
@@ -138,6 +155,28 @@ def build_argparser(parser: argparse.ArgumentParser | None = None):
                    help="ddp: flatten per-dtype gradient leaves into "
                         "~N MB flat buckets before the all-reduce "
                         "(torch-DDP style; default: per-leaf)")
+    p.add_argument("--overlap", dest="overlap",
+                   choices=["none", "ring", "ring_fused"], default=None,
+                   help="overlap engine: ring-decompose the strategy's "
+                        "hot collectives (fsdp gathers / tp rejoins) "
+                        "into schedulable ppermute hops; 'ring' is "
+                        "bitwise-identical to 'none', 'ring_fused' "
+                        "(fsdp) fuses the gather into the matmuls")
+    p.add_argument("--accum-steps", dest="accum_steps", type=int,
+                   default=None,
+                   help="microbatched gradient accumulation: scan over "
+                        "N microbatches per optimizer step (must divide "
+                        "the per-device batch)")
+    p.add_argument("--quantize-grads", dest="quantize_grads",
+                   action="store_true", default=None,
+                   help="ddp: int8 quantized bucketed gradient "
+                        "all-reduce (per-bucket scales; ~8x less bus "
+                        "traffic, within one half-quantum of exact)")
+    p.add_argument("--error-feedback", dest="error_feedback",
+                   action="store_true", default=None,
+                   help="with --quantize-grads: carry the quantization "
+                        "error as a per-rank residual applied to the "
+                        "next step's buckets (EF-SGD)")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", type=str,
                    default=None,
                    help="save full RunState (params+opt+PRNG+data cursor) "

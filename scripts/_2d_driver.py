@@ -97,14 +97,30 @@ def _leg(mode, args, rest, cfg, ctx):
           f"mesh={dict(mesh.shape)} batch={cfg.batch_size} "
           f"seq={cfg.sequence_length} platform={jax.devices()[0].platform}")
 
+    if cfg.overlap != "none" and mode != "tp":
+        raise SystemExit(f"--overlap {cfg.overlap} is wired for the tp "
+                         f"leg here (and train_fsdp.py); the sp ring's "
+                         f"own choreography is not yet contracted")
+    if cfg.overlap == "ring_fused":
+        raise SystemExit("--overlap ring_fused is an fsdp mode "
+                         "(decomposed gather-matmuls); tp uses "
+                         "--overlap ring")
+    if cfg.accum_steps > 1 and (cfg.batch_size // dp) % cfg.accum_steps:
+        raise SystemExit(f"--accum-steps {cfg.accum_steps} must divide "
+                         f"the per-dp-rank batch "
+                         f"{cfg.batch_size}/{dp}={cfg.batch_size // dp}")
+
     key = set_seed(cfg.seed)
     params = T.init_params(key, mcfg)
     if mode == "sp":
         shards = fsdp.shard_params_fsdp(params, mesh, "dp")
-        step = sequence.make_sp_train_step(shards, mcfg, mesh)
+        step = sequence.make_sp_train_step(shards, mcfg, mesh,
+                                           accum_steps=cfg.accum_steps)
     else:
         shards = tensor.shard_params_tp(params, mesh)
-        step = tensor.make_tp_train_step(shards, mcfg, mesh)
+        step = tensor.make_tp_train_step(shards, mcfg, mesh,
+                                         overlap=cfg.overlap,
+                                         accum_steps=cfg.accum_steps)
     del params
     opt_state = fsdp.init_fsdp_opt_state(shards)
     print_memory_stats(f"{name}-at-rest", params=shards,
@@ -124,9 +140,10 @@ def _leg(mode, args, rest, cfg, ctx):
               if mode == "sp" else "2 psums/layer + grad syncs")
     print(f"[{name}] per-step collectives (HLO): {counts} ({expect})")
     from distributed_training_sandbox_tpu.analysis import evaluate_contract
-    verdict = evaluate_contract(mode, counts, params=shards, mesh=mesh,
+    cname = f"{mode}_ring" if cfg.overlap == "ring" else mode
+    verdict = evaluate_contract(cname, counts, params=shards, mesh=mesh,
                                 n_layers=mcfg.num_hidden_layers)
-    print(f"[{name}] contract[{mode}]: {verdict.summary()}")
+    print(f"[{name}] contract[{cname}]: {verdict.summary()}")
     ctx.verify_contract(verdict)
 
     flops_tok = get_model_flops_per_token(mcfg, cfg.sequence_length)

@@ -109,17 +109,25 @@ def _mlp_leg(args, cfg, ctx):
     assert err == 0.0, f"params diverged across replicas: {err}"
     print(f"[ddp] param sync check passed (divergence {err})")
 
+    from distributed_training_sandbox_tpu.parallel import ddp as DDP
+
     opt_state = optim.sgd_init(params)
+    if cfg.quantize_grads and cfg.error_feedback:
+        # EF residual rides the opt-state slot (per-rank, dp-sharded)
+        opt_state = (opt_state, DDP.init_grad_residual(params, ws))
     # resume: restore params/opt/PRNG root before the step is lowered so
     # the collective contract below is evaluated on the RESTORED state
     rs = ctx.restore(like=RZ.RunState(params=params, opt_state=opt_state,
                                       prng_key=key))
     if rs is not None:
         params, opt_state = rs.params, rs.opt_state
-    contract_name = "ddp_bucketed" if cfg.bucket_mb else "ddp"
+    contract_name = ("ddp_q8" if cfg.quantize_grads
+                     else "ddp_bucketed" if cfg.bucket_mb else "ddp")
     step = make_ddp_train_step(
         mse_loss, lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3),
-        mesh, "dp", bucket_mb=cfg.bucket_mb)
+        mesh, "dp", bucket_mb=cfg.bucket_mb,
+        quantize_grads=cfg.quantize_grads,
+        error_feedback=cfg.error_feedback)
 
     # batch: synthetic randn regression, global batch sharded over dp
     def make_batch(key):
@@ -135,11 +143,17 @@ def _mlp_leg(args, cfg, ctx):
 
     counts = count_collectives(step, params, opt_state, make_batch(key))
     n_params = len(jax.tree.leaves(params))
-    print(f"[ddp] per-step collectives (HLO): {counts} "
-          f"(expect {n_params} grad all_reduces + loss mean + barrier)"
-          if not cfg.bucket_mb else
-          f"[ddp] per-step collectives (HLO): {counts} "
-          f"(bucketed: ~{cfg.bucket_mb} MB flat grad buckets)")
+    if cfg.quantize_grads:
+        expect = (f"int8 q8 buckets of "
+                  f"~{cfg.bucket_mb or DDP.DEFAULT_Q8_BUCKET_MB} MB: "
+                  f"2 all_gathers each"
+                  + (", EF residual" if cfg.error_feedback else ""))
+    elif cfg.bucket_mb:
+        expect = f"bucketed: ~{cfg.bucket_mb} MB flat grad buckets"
+    else:
+        expect = (f"expect {n_params} grad all_reduces + loss mean "
+                  f"+ barrier")
+    print(f"[ddp] per-step collectives (HLO): {counts} ({expect})")
     from distributed_training_sandbox_tpu.analysis import evaluate_contract
     verdict = evaluate_contract(
         contract_name, counts, params=params, mesh=mesh,
@@ -261,17 +275,24 @@ def _classification_leg(args, cfg, ctx):
     print(f"[ddp] dataset: {len(examples)} examples "
           f"(per-rank contiguous shards, pad-to-multiple-of-8 collate)")
 
+    from distributed_training_sandbox_tpu.parallel import ddp as DDP
+
     opt_state = optim.sgd_init(params)
+    if cfg.quantize_grads and cfg.error_feedback:
+        opt_state = (opt_state, DDP.init_grad_residual(params, ws))
     rs = ctx.restore(like=RZ.RunState(params=params, opt_state=opt_state,
                                       prng_key=key))
     if rs is not None:
         params, opt_state = rs.params, rs.opt_state
     loss_fn = functools.partial(classification_loss, cfg=mcfg)
-    contract_name = "ddp_bucketed" if cfg.bucket_mb else "ddp"
+    contract_name = ("ddp_q8" if cfg.quantize_grads
+                     else "ddp_bucketed" if cfg.bucket_mb else "ddp")
     step = make_ddp_train_step(
         lambda p, b: loss_fn(p, b),
         lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3),
-        mesh, "dp", bucket_mb=cfg.bucket_mb)
+        mesh, "dp", bucket_mb=cfg.bucket_mb,
+        quantize_grads=cfg.quantize_grads,
+        error_feedback=cfg.error_feedback)
 
     batches = classification_batches(
         examples, cfg.batch_size, ws, seed=cfg.seed,

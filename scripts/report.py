@@ -16,6 +16,9 @@ Usage:
   python scripts/report.py runs --baseline bench_matrix_tpu.json
   python scripts/report.py runs --steps               # per-step tail
   python scripts/report.py runs --json                # machine-readable
+  python scripts/report.py runs --baseline base_runs \
+      --fail-on-overlap-regression 5   # CI gate: overlap % may not drop
+                                       # more than 5 pp vs baseline
 """
 
 from __future__ import annotations
@@ -47,6 +50,12 @@ def main(argv=None) -> int:
     p.add_argument("--tolerance", type=float, default=0.15,
                    help="allowed fractional slowdown before a metric "
                         "counts as regressed (default 0.15)")
+    p.add_argument("--fail-on-overlap-regression", type=float,
+                   default=None, metavar="PCT",
+                   help="with --baseline: exit nonzero when a run's "
+                        "overlap %% (comm hidden behind compute) drops "
+                        "more than PCT percentage points below its "
+                        "baseline row — the overlap-engine CI gate")
     p.add_argument("--steps", action="store_true",
                    help="also print the last 5 step events per run")
     p.add_argument("--strict", action="store_true",
@@ -73,15 +82,27 @@ def main(argv=None) -> int:
                     schema_problems.append(
                         f"{rec['dir']} step {ev.get('step')}: {prob}")
 
-    comparisons = []
+    if args.fail_on_overlap_regression is not None and not args.baseline:
+        p.error("--fail-on-overlap-regression needs --baseline (the run "
+                "dir or summary to diff overlap %% against)")
+
+    comparisons, overlap_cmp = [], []
     if args.baseline:
         base_rows = R.load_baseline_rows(args.baseline)
         comparisons = R.check_regressions(rows, base_rows,
                                           tolerance=args.tolerance)
+        overlap_cmp = R.check_overlap_regressions(
+            rows, base_rows,
+            max_drop_pp=args.fail_on_overlap_regression
+            if args.fail_on_overlap_regression is not None else 5.0)
     regressed = [c for c in comparisons if c["regressed"]]
+    overlap_regressed = ([c for c in overlap_cmp if c["regressed"]]
+                         if args.fail_on_overlap_regression is not None
+                         else [])
 
     if args.as_json:
         print(json.dumps({"runs": rows, "comparisons": comparisons,
+                          "overlap_comparisons": overlap_cmp,
                           "schema_problems": schema_problems}, indent=2,
                          default=str))
     else:
@@ -107,12 +128,18 @@ def main(argv=None) -> int:
                       f"tolerance")
             elif comparisons:
                 print("\nno regressions beyond tolerance")
+            print(f"\n## Overlap & step-time deltas vs {args.baseline}\n")
+            print(R.render_overlap_deltas(overlap_cmp))
+            if overlap_regressed:
+                print(f"\nOVERLAP REGRESSIONS: {len(overlap_regressed)} "
+                      f"run(s) lost more than "
+                      f"{args.fail_on_overlap_regression:g} pp of overlap")
         if schema_problems:
             print("\n## Schema violations\n")
             for prob in schema_problems:
                 print(f"* {prob}")
 
-    if regressed or schema_problems:
+    if regressed or schema_problems or overlap_regressed:
         return 1
     return 0
 

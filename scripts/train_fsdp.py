@@ -120,9 +120,18 @@ def _leg(args, rest, cfg, ctx):
     if rs is not None:
         shards, opt_state = rs.params, rs.opt_state
 
+    if cfg.overlap != "none" and args.variant != "explicit":
+        raise SystemExit(f"--overlap {cfg.overlap} rewires the explicit "
+                         f"shard_map choreography; the auto variant's "
+                         f"schedule belongs to XLA (drop --variant auto)")
+    if cfg.accum_steps > 1 and (cfg.batch_size // ws) % cfg.accum_steps:
+        raise SystemExit(f"--accum-steps {cfg.accum_steps} must divide "
+                         f"the per-device batch "
+                         f"{cfg.batch_size}/{ws}={cfg.batch_size // ws}")
     if args.variant == "explicit":
         step = fsdp.make_fsdp_train_step(
-            shards, mcfg, mesh, reshard_after_forward=args.reshard)
+            shards, mcfg, mesh, reshard_after_forward=args.reshard,
+            overlap=cfg.overlap, accum_steps=cfg.accum_steps)
     else:
         step = fsdp.make_fsdp_auto_train_step(shards, mcfg, mesh)
 
@@ -144,15 +153,18 @@ def _leg(args, rest, cfg, ctx):
     probe = (jnp.zeros((cfg.batch_size, cfg.sequence_length), jnp.int32),) * 2
     counts = count_collectives(step, shards, opt_state, probe)
     print(f"[fsdp] per-step collectives (HLO): {counts}")
-    # the auto variant's choreography is XLA's choice, not ours to contract
+    # the auto variant's choreography is XLA's choice, not ours to
+    # contract; ring_fused's decomposed-matmul site counts are pinned by
+    # tests/test_overlap.py rather than a registry formula
     verdict = None
-    if args.variant == "explicit":
+    if args.variant == "explicit" and cfg.overlap != "ring_fused":
         from distributed_training_sandbox_tpu.analysis import (
             evaluate_contract)
-        verdict = evaluate_contract("fsdp", counts, params=shards,
+        cname = "fsdp_ring" if cfg.overlap == "ring" else "fsdp"
+        verdict = evaluate_contract(cname, counts, params=shards,
                                     mesh=mesh,
                                     n_layers=mcfg.num_hidden_layers)
-        print(f"[fsdp] contract[fsdp]: {verdict.summary()}")
+        print(f"[fsdp] contract[{cname}]: {verdict.summary()}")
     ctx.verify_contract(verdict)
 
     tokens_per_step = cfg.batch_size * cfg.sequence_length

@@ -221,3 +221,57 @@ def test_lint_tree_walks_seeded_dir(tmp_path):
         "from jax import lax\ny = lax.psum(1, 'dp')\n")
     findings = lint_tree(tmp_path)
     assert _checks(findings) == {"collective-outside-shard-map"}
+
+
+def test_gather_in_step_with_ring_variant_is_error():
+    src = (
+        "from distributed_training_sandbox_tpu.ops.collectives import "
+        "ring_all_gather\n"
+        "from jax import lax\n"
+        "def make_train_step():\n"
+        "    def step(w, b):\n"
+        "        full = lax.all_gather(w, 'dp', axis=0, tiled=True)\n"
+        "        return full @ b\n"
+        "    return shard_map(step)\n")
+    f = lint_source(src, "s.py")
+    hits = [x for x in f if x.check == "gather-in-step"]
+    assert len(hits) == 1 and hits[0].severity == "error"
+    assert "overlap='ring'" in hits[0].message
+
+
+def test_gather_in_step_without_ring_variant_is_fine():
+    src = (
+        "from jax import lax\n"
+        "def make_train_step():\n"
+        "    def step(w, b):\n"
+        "        return lax.all_gather(w, 'dp', axis=0, tiled=True) @ b\n"
+        "    return shard_map(step)\n")
+    assert not [x for x in lint_source(src, "s.py")
+                if x.check == "gather-in-step"]
+
+
+def test_gather_outside_step_fn_is_fine():
+    src = (
+        "from distributed_training_sandbox_tpu.ops.collectives import "
+        "ring_all_gather\n"
+        "from jax import lax\n"
+        "def rebuild(w):\n"
+        "    return lax.all_gather(w, 'dp', axis=0, tiled=True)\n"
+        "f = shard_map(rebuild)\n")
+    assert not [x for x in lint_source(src, "s.py")
+                if x.check == "gather-in-step"]
+
+
+def test_gather_ok_pragma_suppresses():
+    src = (
+        "from distributed_training_sandbox_tpu.ops.collectives import "
+        "ring_all_gather\n"
+        "from jax import lax\n"
+        "def make_train_step():\n"
+        "    def step(w, b):\n"
+        "        # gather-ok: the monolithic baseline A/B leg\n"
+        "        full = lax.all_gather(w, 'dp', axis=0, tiled=True)\n"
+        "        return full @ b\n"
+        "    return shard_map(step)\n")
+    assert not [x for x in lint_source(src, "s.py")
+                if x.check == "gather-in-step"]

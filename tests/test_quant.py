@@ -262,3 +262,145 @@ def test_save_dots_q8_halves_saved_activation_plan():
     # q8 must sit clearly under save_dots (saved bytes roughly halve;
     # the non-saved share of the plan dilutes the ratio)
     assert q8 < 0.8 * dots, (q8, dots, full)
+
+
+# ------------------------------------------ quantized grad all-reduce
+
+def _q8_sync_fns(mesh8, bucket_mb=0.05):
+    """smap-jitted (grads) -> (exact mean, q8 mean, error bound) and the
+    EF step (grads, residual) -> (q8 mean, new residual).  The bound is
+    the analytical one the docstring promises: each rank contributes at
+    most half a quantum of ITS bucket scale, so after the mean the
+    per-element error is <= mean_r(scale_r)/2."""
+    from distributed_training_sandbox_tpu.parallel import ddp as D
+
+    def compare(g):
+        exact = C.tree_all_reduce(g, "dp", mean=True)
+        q8, _ = D.quantized_bucket_all_reduce(g, "dp", bucket_mb)
+        amax = jax.tree.map(
+            lambda x: jnp.max(jnp.abs(x.astype(jnp.float32))), g)
+        bound = jax.tree.map(
+            lambda a: C.all_reduce(
+                jnp.where(a > 0, a / 127.0, 1.0), "dp", mean=True) / 2,
+            amax)
+        return exact, q8, bound
+
+    def ef_step(g, res):
+        q8, new_res = D.quantized_bucket_all_reduce(
+            g, "dp", bucket_mb, residual=res)
+        return q8, new_res
+
+    cmp_f = jax.jit(C.smap(compare, mesh8, P("dp"), (P(), P(), P())))
+    ef_f = jax.jit(C.smap(ef_step, mesh8, (P("dp"), P("dp")),
+                          (P(), P("dp"))))
+    return cmp_f, ef_f
+
+
+def test_q8_allreduce_roundtrip_bound(mesh8):
+    """Per element the q8 sync sits within half a (rank-averaged) bucket
+    quantum of the exact mean — and the error is real (the bound is a
+    live constraint, not slack)."""
+    g = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 64, 32))
+         * 2.7}
+    cmp_f, _ = _q8_sync_fns(mesh8)
+    exact, q8, bound = cmp_f(g)
+    err = float(jnp.max(jnp.abs(q8["w"] - exact["w"])))
+    assert 0 < err <= float(bound["w"]) + 1e-7
+    # and the sync is deterministic (ascending-rank sum)
+    _, q8b, _ = cmp_f(g)
+    np.testing.assert_array_equal(np.asarray(q8["w"]),
+                                  np.asarray(q8b["w"]))
+
+
+def test_q8_allreduce_error_feedback_compensates(mesh8):
+    """EF-SGD invariant: with the residual, the CUMULATIVE applied
+    gradient over k identical steps stays within ~one quantum of
+    k x the exact mean (the per-step error is carried, not dropped), so
+    the cumulative error does NOT grow with k — without EF it grows
+    linearly."""
+    from distributed_training_sandbox_tpu.parallel import ddp as D
+
+    g = {"w": jax.random.normal(jax.random.PRNGKey(1), (8, 64, 32))
+         * 1.3}
+    cmp_f, ef_f = _q8_sync_fns(mesh8)
+    exact, q8_plain, bound = cmp_f(g)
+    k = 6
+    res = {"w": jnp.zeros((8, 64, 32), jnp.float32)}
+    applied = jnp.zeros_like(exact["w"])
+    for _ in range(k):
+        q8, res = ef_f(g, res)
+        applied = applied + q8["w"]
+    ef_cum_err = float(jnp.max(jnp.abs(applied - k * exact["w"])))
+    plain_cum_err = k * float(jnp.max(jnp.abs(q8_plain["w"]
+                                              - exact["w"])))
+    assert ef_cum_err < plain_cum_err
+    # bounded by ~2 quanta regardless of k (residual <= one local
+    # quantum per rank, plus the current step's half-quantum)
+    assert ef_cum_err <= 4 * float(bound["w"]) + 1e-7
+
+
+def test_ddp_q8_step_trains_and_meets_contract(mesh8):
+    """The ddp_q8 choreography end to end: the toy MLP trains, the step
+    stays within a whisker of the exact-sync step, and the lowered
+    collective sites match the registered contract."""
+    from distributed_training_sandbox_tpu.analysis import (
+        evaluate_contract)
+    from distributed_training_sandbox_tpu.models import zero_toy_mlp
+    from distributed_training_sandbox_tpu.models.mlp import mse_loss
+    from distributed_training_sandbox_tpu.ops import count_collectives
+    from distributed_training_sandbox_tpu.parallel import ddp as D, optim
+
+    key = jax.random.PRNGKey(0)
+    params = zero_toy_mlp(key, scale=100)
+    kx, ky = jax.random.split(key)
+    batch = (jax.random.normal(kx, (8, 100)),
+             jax.random.normal(ky, (8, 100)))
+    upd = lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3)  # noqa: E731
+    sq = D.make_ddp_train_step(mse_loss, upd, mesh8, "dp", donate=False,
+                               quantize_grads=True, bucket_mb=0.05)
+    s0 = D.make_ddp_train_step(mse_loss, upd, mesh8, "dp", donate=False)
+    opt = optim.sgd_init(params)
+    counts = count_collectives(sq, params, opt, batch)
+    verdict = evaluate_contract("ddp_q8", counts, params=params,
+                                mesh=mesh8, bucket_mb=0.05)
+    assert verdict.ok, verdict.summary()
+    assert counts["all_reduce"] == 2       # loss mean + barrier only
+    p0, _, _ = s0(params, opt, batch)
+    pq, _, _ = sq(params, opt, batch)
+    for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(pq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-6)
+    losses = []
+    pp, oo = params, opt
+    for _ in range(6):
+        pp, oo, loss = sq(pp, oo, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_ddp_q8_error_feedback_state_threads(mesh8):
+    """error_feedback=True: the step's state slot becomes
+    (opt_state, residual); the residual leaves are per-rank
+    (dp-stacked), become nonzero after a step, and the step re-accepts
+    its own output state."""
+    from distributed_training_sandbox_tpu.models import zero_toy_mlp
+    from distributed_training_sandbox_tpu.models.mlp import mse_loss
+    from distributed_training_sandbox_tpu.parallel import ddp as D, optim
+
+    key = jax.random.PRNGKey(0)
+    params = zero_toy_mlp(key, scale=100)
+    kx, ky = jax.random.split(key)
+    batch = (jax.random.normal(kx, (8, 100)),
+             jax.random.normal(ky, (8, 100)))
+    step = D.make_ddp_train_step(
+        mse_loss, lambda g, s, p: optim.sgd_update(g, s, p, lr=1e-3),
+        mesh8, "dp", donate=False, quantize_grads=True,
+        error_feedback=True, bucket_mb=0.05)
+    state = (optim.sgd_init(params), D.init_grad_residual(params, 8))
+    p1, state, _ = step(params, state, batch)
+    _, residual = state
+    leaf = jax.tree.leaves(residual)[0]
+    assert leaf.shape[0] == 8                   # per-rank leading dim
+    assert any(float(jnp.abs(r).max()) > 0
+               for r in jax.tree.leaves(residual))
+    p2, state, _ = step(p1, state, batch)       # state round-trips

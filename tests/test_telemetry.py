@@ -260,3 +260,65 @@ def test_ddp_toy_leg_telemetry_and_report_roundtrip(tmp_path):
     rc = report_main([str(results), "--baseline", str(baseline),
                       "--tolerance", "0.5"])
     assert rc == 1
+
+
+# ------------------------------------------- overlap A/B (report gate)
+
+def _fake_overlap_run(root, run_id, strategy, step_ms, overlap):
+    d = os.path.join(root, run_id)
+    w = MetricsWriter(d)
+    w.write_manifest({"run_id": run_id, "strategy": strategy,
+                      "model": "tiny", "device_count": 8,
+                      "platform": "cpu",
+                      "config": {"sequence_length": 128, "batch_size": 8}})
+    w.append_step(step_event(0, loss=1.0))
+    w.write_summary({"run_id": run_id, "strategy": strategy,
+                     "model": "tiny", "status": "completed",
+                     "sequence_length": 128, "batch_size": 8,
+                     "step_time_ms": step_ms,
+                     "comm_split": {"comm_fraction": 0.4,
+                                    "overlap_fraction": overlap}})
+    w.close()
+    return d
+
+
+def test_overlap_deltas_and_gate(tmp_path):
+    """check_overlap_regressions: pp deltas + step-time delta per
+    comparable pair; the regression flag trips only past max_drop_pp."""
+    cur = os.path.join(str(tmp_path), "cur")
+    base = os.path.join(str(tmp_path), "base")
+    _fake_overlap_run(cur, "r2-fsdp", "fsdp", 8.0, 0.22)
+    _fake_overlap_run(base, "r1-fsdp", "fsdp", 10.0, 0.60)
+    rows = [R.run_row(rec) for rec in R.discover_runs([cur])]
+    brows = [R.run_row(rec) for rec in R.discover_runs([base])]
+    res = R.check_overlap_regressions(rows, brows, max_drop_pp=5.0)
+    assert len(res) == 1
+    r = res[0]
+    assert r["overlap_delta_pp"] == pytest.approx(-38.0)
+    assert r["step_time_delta"] == pytest.approx(-0.2)
+    assert r["regressed"]
+    # a 38 pp drop is fine under a 40 pp budget
+    res = R.check_overlap_regressions(rows, brows, max_drop_pp=40.0)
+    assert not res[0]["regressed"]
+    table = R.render_overlap_deltas(res)
+    assert "22.0" in table and "60.0" in table and "-38.0" in table
+
+
+def test_report_cli_fails_on_overlap_regression(tmp_path):
+    """scripts/report.py --fail-on-overlap-regression: nonzero exit when
+    overlap drops past the budget, zero when within it."""
+    from scripts.report import main as report_main
+
+    cur = os.path.join(str(tmp_path), "cur")
+    base = os.path.join(str(tmp_path), "base")
+    _fake_overlap_run(cur, "r2-fsdp", "fsdp", 8.0, 0.30)
+    _fake_overlap_run(base, "r1-fsdp", "fsdp", 8.5, 0.60)
+    rc = report_main([cur, "--baseline", base,
+                      "--fail-on-overlap-regression", "5"])
+    assert rc == 1
+    rc = report_main([cur, "--baseline", base,
+                      "--fail-on-overlap-regression", "50"])
+    assert rc == 0
+    # without the flag the overlap table renders but never gates
+    rc = report_main([cur, "--baseline", base])
+    assert rc == 0
