@@ -43,7 +43,6 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import re
 import sys
 import time
 from pathlib import Path
@@ -231,22 +230,78 @@ def measure(model_name: str, seq: int, batch: int, num_steps: int = 8,
     }
 
 
+def predict_row_gb(model_name: str, seq: int, batch: int,
+                   cfg_overrides: dict | None,
+                   step_kwargs: dict | None) -> float | None:
+    """Analytic per-device waterline for one matrix row — the planner's
+    pre-flight, microseconds instead of the compile that would OOM.
+    None for the pjit-auto rows (XLA owns their buffer plan)."""
+    import jax
+    from distributed_training_sandbox_tpu.memory_plan import (
+        analytic_waterline)
+    from distributed_training_sandbox_tpu.models import transformer as T
+    if step_kwargs is None:
+        return None
+    cfg = getattr(T, model_name)
+    if cfg_overrides:
+        cfg = dataclasses.replace(cfg, **cfg_overrides)
+    ws = len(jax.devices())
+    batch = -(-batch // ws) * ws
+    pred = analytic_waterline(
+        cfg, batch=batch, seq=seq, ws=ws,
+        state_precision=step_kwargs.get("state_precision", "full"))
+    return round(pred.gb, 2)
+
+
+def _failure_row(name: str, e: Exception,
+                 predicted_gb: float | None = None) -> dict:
+    """Structured failure row: OOMs carry the compiler's own
+    needed/capacity GB (``utils.memory.parse_hbm_oom``) next to the
+    planner's prediction, so the memory edge is machine-readable instead
+    of a raw error string."""
+    from distributed_training_sandbox_tpu.utils.memory import (
+        classify_failure, parse_hbm_oom)
+    kind, msg = classify_failure(e)
+    row = {"config": name, "error": f"{type(e).__name__}: {msg}",
+           "failure_kind": kind}
+    oom = parse_hbm_oom(str(e))
+    if oom:
+        row["needed_gb"], row["capacity_gb"] = oom
+    if predicted_gb is not None:
+        row["predicted_gb"] = predicted_gb
+    return row
+
+
 def run_matrix(model_name: str, seq: int, base_batch: int):
-    """Measure every knob row; rows that fail (OOM) record the error."""
+    """Measure every knob row.  Each row is pre-flighted through the
+    analytic waterline predictor: predicted-over-capacity configs are
+    skipped with a ``"skipped": "predicted_oom"`` row (no compile burnt,
+    no runtime OOM); rows that still fail record a structured error."""
+    from distributed_training_sandbox_tpu.utils.memory import (
+        hbm_capacity_gb)
     rows = []
+    capacity = hbm_capacity_gb()
     for name, cfg_over, step_kw, bscale, *mk in KNOB_MATRIX:
+        try:
+            pred = predict_row_gb(model_name, seq, base_batch * bscale,
+                                  cfg_over, step_kw)
+        except Exception:  # noqa: BLE001 - prediction must not kill the bench
+            pred = None
+        if pred is not None and capacity is not None and pred > capacity:
+            rows.append({"config": name, "skipped": "predicted_oom",
+                         "predicted_gb": pred,
+                         "capacity_gb": round(capacity, 2)})
+            print(f"[bench] {rows[-1]}", file=sys.stderr, flush=True)
+            continue
         try:
             r = measure(model_name, seq, base_batch * bscale,
                         cfg_overrides=cfg_over, step_kwargs=step_kw,
                         **(mk[0] if mk else {}))
-            rows.append({"config": name, **r})
-        except Exception as e:
-            msg = str(e)
-            # surface the XLA OOM verdict, not the transport wrapper
-            m = re.search(r"Ran out of memory[^\n]*", msg)
-            rows.append({"config": name, "error":
-                         f"{type(e).__name__}: "
-                         f"{m.group(0) if m else msg[:120]}"})
+            rows.append({"config": name, **r,
+                         **({"predicted_gb": pred} if pred is not None
+                            else {})})
+        except Exception as e:  # noqa: BLE001 - every row must report
+            rows.append(_failure_row(name, e, pred))
         print(f"[bench] {rows[-1]}", file=sys.stderr, flush=True)
     return rows
 
@@ -300,6 +355,43 @@ def measure_checkpoint_overhead(model_name: str, seq: int, batch: int,
     }
 
 
+def measure_planner_fit(model_name: str, seq: int, batch: int,
+                        budget_gb: float) -> dict:
+    """The memory planner's payoff row: a batch the raw matrix cannot run
+    (every b8x crossing OOMs at 15.75 GB) re-planned under the device
+    budget — auto-fit picks remat × accum × quant × offload, the chosen
+    config is measured as a real row, and predicted vs budget rides
+    along.  ``NoFittingConfig`` reports the rejection with its predicted
+    waterline instead of burning the compile."""
+    import jax
+    from distributed_training_sandbox_tpu import memory_plan as MP
+    from distributed_training_sandbox_tpu.models import transformer as T
+
+    cfg = getattr(T, model_name)
+    ws = len(jax.devices())
+    batch = -(-batch // ws) * ws
+    try:
+        plan = MP.plan(cfg, batch=batch, seq=seq, ws=ws,
+                       hbm_budget_gb=budget_gb)
+    except MP.NoFittingConfig as e:
+        tight = min(e.plan.rows, key=lambda r: r.prediction.gb)
+        return {"config": "planner_fit", "batch": batch,
+                "skipped": "no_fitting_config",
+                "predicted_gb": round(tight.prediction.gb, 2),
+                "budget_gb": round(budget_gb, 2)}
+    c = plan.best.candidate
+    r = measure(model_name, seq, batch,
+                cfg_overrides={"remat_policy": c.remat_policy,
+                               "matmul_precision": c.matmul_precision},
+                step_kwargs={"reshard_after_forward": True,
+                             "accum_steps": c.accum_steps,
+                             "state_precision": c.state_precision,
+                             "offload": c.offload})
+    return {"config": f"planner_fit[{c.label()}]",
+            "predicted_gb": round(plan.best.prediction.gb, 2),
+            "budget_gb": round(budget_gb, 2), **r}
+
+
 def reference_tflops_per_device() -> float:
     from distributed_training_sandbox_tpu.models import transformer as T
     from distributed_training_sandbox_tpu.utils.flops import (
@@ -349,6 +441,21 @@ def main():
         ckpt_row = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
     print(f"[bench] checkpoint_overhead {ckpt_row}", file=sys.stderr,
           flush=True)
+    # planner payoff row: the OOM-wall batch (8× base — every matrix
+    # crossing at that scale dies on HBM) auto-fitted under the device's
+    # own capacity.  Only meaningful where the backend reports one.
+    from distributed_training_sandbox_tpu.utils.memory import (
+        hbm_capacity_gb)
+    plan_row = None
+    capacity = hbm_capacity_gb()
+    if capacity is not None:
+        try:
+            plan_row = measure_planner_fit(model, seq, bs * 8, capacity)
+        except Exception as e:  # noqa: BLE001 - the bench line must print
+            plan_row = {"config": "planner_fit",
+                        "error": f"{type(e).__name__}: {str(e)[:120]}"}
+        print(f"[bench] planner_fit {plan_row}", file=sys.stderr,
+              flush=True)
     by_cfg = {r["config"]: r for r in good}
     pump_ab = None
     if {"explicit_reshard", "explicit_reshard_syncstep"} <= set(by_cfg):
@@ -385,6 +492,7 @@ def main():
         "pump_ab": pump_ab,
         "overlap_ab": overlap_ab,
         "checkpoint_overhead": ckpt_row,
+        "planner_fit": plan_row,
         "matrix": matrix,
     }
     print(json.dumps(out))

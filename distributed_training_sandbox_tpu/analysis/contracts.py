@@ -71,12 +71,18 @@ class CollectiveContract:
     ``allows_full_param_gather``: strategies that materialize full params
     by design (ZeRO-3 / FSDP / SP) — exempt from the replication lint.
     ``payload_bytes(ctx)``: approximate per-step bytes on the wire, for
-    the manifest / report (informational, never asserted)."""
+    the manifest / report (informational, never asserted).
+    ``host_transfers(ctx)``: declared MoveToHost/MoveToDevice custom-call
+    count ranges for strategies whose choreography *includes* host
+    offload (``memory_plan.OffloadPlan.host_transfer_counts``, read off
+    ``ctx.extra["offload"]``) — turns ``hlo_lint``'s host-transfer check
+    from forbid into count-check; None keeps the strict forbid."""
     strategy: str
     axes: tuple[str, ...]
     counts: Callable[[ContractContext], dict]
     allows_full_param_gather: bool = False
     payload_bytes: Callable[[ContractContext], int] | None = None
+    host_transfers: Callable[[ContractContext], dict] | None = None
     description: str = ""
 
 
@@ -169,6 +175,25 @@ def _zero2_counts(c: ContractContext) -> dict:
     return {"all_reduce": c.n_leaves + 2, "reduce_scatter": c.n_leaves}
 
 
+def _offload_host_transfers(c: ContractContext) -> dict:
+    """The declared per-step MoveToHost/MoveToDevice count ranges, read
+    off the :class:`memory_plan.OffloadPlan` dict the step build put in
+    ``ctx.extra["offload"]``.  An unsupported-backend fallback build
+    declares zero — the lint then *forbids* transfers, so the fallback
+    is checked, not waved through."""
+    from ..memory_plan.offload import OffloadPlan
+    plan = c.extra.get("offload") or {}
+    if isinstance(plan, OffloadPlan):
+        return plan.host_transfer_counts()
+    return OffloadPlan(
+        mode=plan.get("mode", "none"),
+        supported=bool(plan.get("supported")),
+        n_state_leaves=int(plan.get("n_state_leaves", 0)),
+        state_bytes=int(plan.get("state_bytes", 0)),
+        act_names=tuple(plan.get("act_names") or ()),
+    ).host_transfer_counts()
+
+
 CONTRACTS: dict[str, CollectiveContract] = {
     # per-param grad all_reduce + loss mean + step barrier (DDP/ddp.py:43-47)
     "ddp": CollectiveContract(
@@ -233,6 +258,22 @@ CONTRACTS: dict[str, CollectiveContract] = {
         payload_bytes=lambda c: 3 * c.param_bytes,
         description="one gather + one reduce-scatter site per param leaf "
                     "(scan collapses depth), one loss pmean"),
+    # fsdp with --offload opt: identical collective choreography to fsdp
+    # (the transfers are custom calls, not collectives) PLUS a declared
+    # host-offload transfer budget — MoveToDevice streams the Adam
+    # moments in for the update, MoveToHost parks them back.  Counts
+    # come from the build's OffloadPlan (zero on backends without a
+    # pinned_host space: the fallback step must stay transfer-free).
+    "fsdp_offload": CollectiveContract(
+        "fsdp_offload", ("dp",),
+        lambda c: {"all_reduce": 1,
+                   "all_gather": c.n_leaves,
+                   "reduce_scatter": c.n_leaves},
+        allows_full_param_gather=True,
+        payload_bytes=lambda c: 3 * c.param_bytes,
+        host_transfers=_offload_host_transfers,
+        description="fsdp choreography + declared MoveToHost/MoveToDevice "
+                    "streaming of host-resident optimizer state"),
     # fsdp with --overlap ring: the overlap engine's decomposed gathers
     # (ops.collectives.ring_all_gather) — ppermute hops instead of
     # monolithic all_gathers, bitwise-identical losses

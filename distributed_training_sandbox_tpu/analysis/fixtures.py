@@ -19,8 +19,8 @@ from typing import Any, Callable
 from .contracts import CONTRACTS, ContractContext
 
 STRATEGIES = ("ddp", "ddp_bucketed", "ddp_q8", "zero1", "zero2", "zero3",
-              "fsdp", "fsdp_ring", "tp", "tp_ring", "sp", "moe", "gpipe",
-              "1f1b")
+              "fsdp", "fsdp_ring", "fsdp_offload", "tp", "tp_ring", "sp",
+              "moe", "gpipe", "1f1b")
 
 # the canonical bucket size for the ddp_bucketed fixture — small enough
 # that the toy MLP needs several buckets, so the formula is exercised
@@ -115,9 +115,11 @@ def build_strategy(strategy: str, *, mesh=None, scale: int = 100,
                              ctx, donate=True, full_param_shapes=shapes)
 
     # ---- transformer strategies ----------------------------------------
-    if strategy in ("fsdp", "fsdp_ring", "tp", "tp_ring", "sp", "moe"):
+    if strategy in ("fsdp", "fsdp_ring", "fsdp_offload", "tp", "tp_ring",
+                    "sp", "moe"):
         mcfg = T.TINY_LM
-        second_axis = {"fsdp": None, "fsdp_ring": None, "tp": "tp",
+        second_axis = {"fsdp": None, "fsdp_ring": None,
+                       "fsdp_offload": None, "tp": "tp",
                        "tp_ring": "tp", "sp": "sp", "moe": "ep"}[strategy]
         if mesh is None:
             if second_axis is None:
@@ -141,6 +143,26 @@ def build_strategy(strategy: str, *, mesh=None, scale: int = 100,
             step = fsdp.make_fsdp_train_step(
                 shards, mcfg, mesh,
                 overlap="ring" if strategy == "fsdp_ring" else "none")
+        elif strategy == "fsdp_offload":
+            # host-offloaded optimizer state: park the Adam moments in
+            # pinned host memory (identity placement on the CPU sim) and
+            # declare the resulting transfer counts into the contract ctx
+            from ..memory_plan import offload_tree, plan_offload
+            shards = fsdp.shard_params_fsdp(params, mesh)
+            opt0 = fsdp.init_fsdp_opt_state(shards)
+            oplan = plan_offload("opt", opt0)
+            if oplan.supported:
+                opt0 = offload_tree(opt0)
+            step = fsdp.make_fsdp_train_step(shards, mcfg, mesh,
+                                             offload="opt")
+            ctx = ContractContext.capture(
+                params=params, mesh=mesh,
+                n_layers=mcfg.num_hidden_layers,
+                offload=oplan.to_dict())
+            probe = (jnp.zeros((batch_size, seq), jnp.int32),) * 2
+            return StrategyBuild(strategy, step, (shards, opt0, probe),
+                                 _state_advance, mesh, ctx, donate=True,
+                                 full_param_shapes=shapes)
         elif strategy == "sp":
             shards = fsdp.shard_params_fsdp(params, mesh, "dp")
             step = sequence.make_sp_train_step(shards, mcfg, mesh)

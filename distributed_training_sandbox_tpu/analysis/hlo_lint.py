@@ -120,7 +120,48 @@ def check_donation(text: str, *, donate_expected: bool):
         "reallocated every step (2x resident memory)")]
 
 
-def check_host_transfers(text: str):
+_MOVE_PATTERNS = {
+    "move_to_host": r'custom_call_target="MoveToHost"',
+    "move_to_device": r'custom_call_target="MoveToDevice"',
+}
+
+
+def check_host_transfers(text: str, declared=None):
+    """Host-transfer lint over one compiled module.
+
+    ``declared=None`` (the default, and every strategy without an
+    offload contract): ANY host-transfer marker is an error — a
+    device→host sync snuck onto the hot path.
+
+    ``declared`` = the strategy's :class:`OffloadPlan` transfer counts
+    (``{"move_to_host": n | (lo, hi), "move_to_device": ...}``): the
+    declared transfers are a *feature* and get count-checked instead —
+    a count outside the declared range (including any transfer when the
+    declaration is empty/zero, the unsupported-backend fallback) is
+    still an error.  Ancillary markers (placement annotations, S(5)
+    layouts) are part of a declared offload choreography and stop being
+    findings only while at least one transfer is actually declared."""
+    if declared is not None:
+        findings = []
+        expects_any = False
+        for key, pat in _MOVE_PATTERNS.items():
+            got = len(re.findall(pat, text))
+            want = declared.get(key, 0)
+            if want is None:
+                expects_any = True
+                continue
+            lo, hi = want if isinstance(want, tuple) else (want, want)
+            expects_any |= hi > 0
+            if not lo <= got <= hi:
+                findings.append(LintFinding(
+                    "host_transfer", SEV_ERROR,
+                    f"{key}: {got} transfer site(s), offload contract "
+                    f"declares {lo}..{hi} — the step's host-offload "
+                    f"choreography drifted from its declaration"))
+        if expects_any:
+            return findings
+        # empty declaration (e.g. the CPU fallback build): fall through
+        # to the strict scan — nothing may touch host memory spaces
     findings = []
     for pat in _HOST_PATTERNS:
         n = len(re.findall(pat, text))
@@ -174,14 +215,18 @@ def check_replica_axes(instances, mesh, allowed_axes=None):
 
 def lint_compiled_hlo(text: str, *, mesh=None, allowed_axes=None,
                       full_param_shapes=(), allow_full_param_gather=False,
-                      donate_expected=False) -> list[LintFinding]:
-    """Run every check over one compiled-HLO module text."""
+                      donate_expected=False,
+                      declared_host_transfers=None) -> list[LintFinding]:
+    """Run every check over one compiled-HLO module text.
+    ``declared_host_transfers``: the strategy contract's offload
+    declaration (``CollectiveContract.host_transfers(ctx)``) — turns the
+    host-transfer lint from forbid into count-check."""
     instances = collective_instances(text)
     findings = []
     findings += check_replication(
         instances, set(map(tuple, full_param_shapes)),
         allow_full_param_gather=allow_full_param_gather)
     findings += check_donation(text, donate_expected=donate_expected)
-    findings += check_host_transfers(text)
+    findings += check_host_transfers(text, declared_host_transfers)
     findings += check_replica_axes(instances, mesh, allowed_axes)
     return findings

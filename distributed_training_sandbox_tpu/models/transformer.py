@@ -73,6 +73,14 @@ class TransformerConfig:
     # save_dots×int8 OOM wall).
     remat_policy: str = "full"
     # "full" | "save_attn" | "save_dots" | "save_dots_q8"
+    # Host offload of the policy-saved activations (memory planner,
+    # --offload opt_act): the named saved tensors ride
+    # ``save_and_offload_only_these_names`` to pinned host memory instead
+    # of staying resident in HBM — only meaningful for the *named*-save
+    # policies (save_attn / save_dots_q8).  Backends without a
+    # pinned_host space (CPU sim) silently keep the plain save policy
+    # (``memory_plan.offload.supports_host_offload``).
+    offload_activations: bool = False
     # "ring" = exact causal attention over a sequence-sharded mesh axis
     # (``sp_axis``) — context parallelism for sequences past one chip's
     # HBM; only valid inside shard_map (see parallel/sequence.py).
@@ -149,6 +157,15 @@ class TransformerConfig:
             raise ValueError(
                 "moe_router_z_weight rides the aux-loss channel scaled "
                 "by moe_aux_weight — set moe_aux_weight > 0 too")
+        if self.offload_activations and (
+                not self.remat
+                or self.remat_policy not in ("save_attn", "save_dots_q8")):
+            raise ValueError(
+                "offload_activations redirects NAMED saved tensors to "
+                "host memory — it needs remat=True and remat_policy in "
+                "('save_attn', 'save_dots_q8'); "
+                f"got remat={self.remat}, "
+                f"remat_policy={self.remat_policy!r}")
 
     @property
     def resolved_head_dim(self) -> int:
@@ -524,7 +541,24 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
 def resolve_remat_policy(cfg: TransformerConfig):
     """cfg.remat_policy name → jax.checkpoint policy (one mapping for
     every scaffold that remats the layer scan — hidden_states and
-    parallel/pipeline's stage bodies)."""
+    parallel/pipeline's stage bodies).
+
+    With ``cfg.offload_activations`` (and a backend that has a
+    pinned_host space) the named-save policies become
+    ``save_and_offload_only_these_names``: the same tensors survive the
+    backward, but parked in host DRAM instead of HBM — the
+    remat-activation leg of the memory planner's host offload."""
+    if cfg.offload_activations:
+        from ..memory_plan.offload import (
+            OFFLOADABLE_REMAT_NAMES, supports_host_offload)
+        names = OFFLOADABLE_REMAT_NAMES[cfg.remat_policy]
+        if supports_host_offload():
+            return jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=list(names),
+                offload_src="device", offload_dst="pinned_host")
+        # CPU sim: no host space distinct from device — keep the plain
+        # save policy (bitwise-identical math, zero transfers declared)
     return {
         "save_attn":
             jax.checkpoint_policies.save_only_these_names("attn_out"),

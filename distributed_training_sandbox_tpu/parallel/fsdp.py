@@ -239,6 +239,7 @@ def make_fsdp_train_step(
     quantized_gather: bool = False,
     overlap: str = "none",
     accum_steps: int = 1,
+    offload: str = "none",
     sp_axis: str | None = None,
     lr: float = 3e-4,
     lr_schedule: Callable | None = None,
@@ -286,6 +287,17 @@ def make_fsdp_train_step(
     ``lax.scan`` over accum_steps splits of the batch with a donated
     grad carry (see :func:`microbatch_value_and_grad`); must divide the
     per-device batch.
+
+    ``offload`` (memory planner, ``memory_plan/offload.py``): "opt" /
+    "opt_act" park the optimizer state in pinned host memory between
+    steps — the jitted step streams it on-device (MoveToDevice) for the
+    Adam update and back (MoveToHost) after, transfers XLA's scheduler
+    can hide behind the backward.  Pass an opt state placed with
+    ``memory_plan.offload_tree``; the step's state output returns to
+    host placement.  "opt_act" additionally expects
+    ``cfg.offload_activations`` (named remat saves offloaded).  On
+    backends without a pinned_host space the step is built transfer-free
+    and is bitwise-identical to ``offload="none"``.
     """
     ws = int(mesh.shape[axis])
     if overlap not in OVERLAP_MODES:
@@ -308,6 +320,11 @@ def make_fsdp_train_step(
                              "dim (use overlap='ring')")
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    from ..memory_plan.offload import (
+        DEVICE_KIND, HOST_KIND, OFFLOAD_MODES as _OFF,
+        stream_tree, supports_host_offload)
+    if offload not in _OFF:
+        raise ValueError(f"offload={offload!r}; choose from {_OFF}")
     if sp_axis is not None:
         cfg = dataclasses.replace(cfg, attention_impl="ring",
                                   sp_axis=sp_axis)
@@ -404,6 +421,19 @@ def make_fsdp_train_step(
     sharded = C.smap(step, mesh,
                      in_specs=(specs, state_specs, batch_spec),
                      out_specs=(specs, state_specs, P()))
+    if offload != "none" and supports_host_offload():
+        # host-resident opt state: stream it on-device for the update and
+        # back after — the MoveToDevice/MoveToHost pair the offload
+        # contract declares (memory_plan.OffloadPlan).  Transfers sit
+        # OUTSIDE shard_map (each leaf keeps its partition spec, only the
+        # memory space changes) so the choreography inside is untouched.
+        def offload_step(shards, opt_state, batch):
+            opt_dev = stream_tree(opt_state, DEVICE_KIND)
+            shards, opt_dev, loss = sharded(shards, opt_dev, batch)
+            return shards, stream_tree(opt_dev, HOST_KIND), loss
+
+        return jax.jit(offload_step,
+                       donate_argnums=(0, 1) if donate else ())
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
 

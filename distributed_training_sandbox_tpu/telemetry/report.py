@@ -124,6 +124,16 @@ def run_row(rec: dict) -> dict:
         # segments below the main table
         "lineage": man.get("lineage"),
     }
+    # memory planner record (scripts record it in manifest extra):
+    # predicted analytic waterline, the compiler-reported one when the
+    # run was planned, and the budget it was judged against
+    mp = (man.get("extra") or {}).get("memory_plan") or {}
+    for src, dst in (("predicted_gb", "predicted_gb"),
+                     ("compiled_gb", "compiled_gb"),
+                     ("budget_gb", "hbm_budget_gb"),
+                     ("auto_fit", "auto_fit")):
+        if mp.get(src) is not None:
+            row[dst] = mp[src]
     for k in ("step_time_ms", "tokens_per_second", "tflops_per_device",
               "avg_loss", "final_loss", "peak_memory_gb"):
         if summ.get(k) is not None:
@@ -198,14 +208,33 @@ def _fmt(v, spec=".1f") -> str:
     return str(v)
 
 
+def _mem_cell(r: dict) -> str:
+    """Memory column: the compiler-reported waterline when the run was
+    planned, else the analytic prediction (``~`` prefix), else the
+    tracker's sampled allocator peak; budget appended when one gated the
+    run."""
+    if r.get("compiled_gb") is not None:
+        cell = _fmt(float(r["compiled_gb"]), ".2f")
+    elif r.get("predicted_gb") is not None:
+        cell = "~" + _fmt(float(r["predicted_gb"]), ".2f")
+    elif r.get("peak_memory_gb") is not None:
+        cell = _fmt(float(r["peak_memory_gb"]), ".2f")
+    else:
+        return "—"
+    if r.get("hbm_budget_gb") is not None:
+        cell += f"/{float(r['hbm_budget_gb']):.1f}"
+    return cell
+
+
 def render_table(rows: list[dict]) -> str:
     """Strategy × payload-shape side-by-side markdown table."""
     if not rows:
         return "_no runs found_"
     out = ["| run | strategy | model | seq | batch | dev | steps | "
-           "step ms | tok/s | TFLOPS/dev | comm % | overlap % | "
+           "step ms | tok/s | TFLOPS/dev | mem GB | comm % | overlap % | "
            "host syncs | collectives/step | status |",
-           "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
+           "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
+           "---|"]
     for r in sorted(rows, key=lambda r: (r.get("strategy") or "",
                                          str(r.get("model")),
                                          r.get("run_id") or "")):
@@ -228,6 +257,7 @@ def render_table(rows: list[dict]) -> str:
             f"| {_fmt(r.get('step_time_ms'), '.2f')} "
             f"| {_fmt(r.get('tokens_per_second'), '.0f')} "
             f"| {_fmt(r.get('tflops_per_device'), '.2f')} "
+            f"| {_mem_cell(r)} "
             f"| {_fmt(100 * comm if comm is not None else None, '.1f')} "
             f"| {_fmt(100 * ovl if ovl is not None else None, '.1f')} "
             f"| {_fmt(r.get('host_sync_count'), 'd')} "

@@ -71,6 +71,17 @@ class TrainConfig:
     # re-applied next step instead of compounding.
     quantize_grads: bool = False
     error_feedback: bool = False
+    # --- memory planner (memory_plan/) -----------------------------------
+    # offload: park optimizer state ("opt") — plus the named remat-saved
+    # activations ("opt_act") — in pinned host memory, streamed around
+    # the step under a declared transfer contract; hbm_budget_gb is the
+    # per-device budget the pre-flight waterline predictor judges
+    # against (default: the device's own bytes_limit when exposed);
+    # auto_fit lets the planner pick remat × accum × quant × offload to
+    # fit the target batch under that budget.
+    offload: str = "none"
+    auto_fit: bool = False
+    hbm_budget_gb: float | None = None
     # --- resilience runtime (resilience/) --------------------------------
     # checkpoint_dir: RunState checkpoints (params + opt + PRNG root +
     # data cursor + loss log) land here; checkpoint_every=N saves async
@@ -177,6 +188,22 @@ def build_argparser(parser: argparse.ArgumentParser | None = None):
                    help="with --quantize-grads: carry the quantization "
                         "error as a per-rank residual applied to the "
                         "next step's buckets (EF-SGD)")
+    p.add_argument("--offload", dest="offload",
+                   choices=["none", "opt", "opt_act"], default=None,
+                   help="host offload: park optimizer state (opt) — and "
+                        "the named remat-saved activations (opt_act) — "
+                        "in pinned host memory, streamed around the step "
+                        "under a declared transfer contract")
+    p.add_argument("--auto-fit", dest="auto_fit", action="store_true",
+                   default=None,
+                   help="memory planner: search remat × accum × quant × "
+                        "offload and run the best predicted-fitting "
+                        "config under --hbm-budget-gb")
+    p.add_argument("--hbm-budget-gb", dest="hbm_budget_gb", type=float,
+                   default=None,
+                   help="per-device HBM budget the pre-flight waterline "
+                        "prediction is judged against (default: the "
+                        "device's reported capacity when exposed)")
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", type=str,
                    default=None,
                    help="save full RunState (params+opt+PRNG+data cursor) "

@@ -33,6 +33,29 @@ def classify_failure(e: Exception) -> tuple[str, str]:
     return "error", f"{type(e).__name__}: {msg[:200]}"
 
 
+def parse_hbm_oom(msg: str) -> tuple[float, float] | None:
+    """``(needed_gb, capacity_gb)`` from XLA's HBM verdict — the
+    ``Used X.XXG of Y.YYG hbm`` clause its compile- and runtime-OOM
+    messages both carry — or None when the text carries no such verdict.
+    The ONE place this regex lives: ``scripts/memory_waterline.py``,
+    ``bench.py``'s structured OOM rows and the memory planner's
+    compiler-OOM fallback all parse through here."""
+    import re
+    m = re.search(r"Used ([\d.]+)G(?:iB)? of ([\d.]+)G(?:iB)? hbm", msg)
+    if m:
+        return float(m.group(1)), float(m.group(2))
+    return None
+
+
+def hbm_capacity_gb(device: jax.Device | None = None) -> float | None:
+    """Per-device accelerator memory capacity in GB from the allocator's
+    ``bytes_limit``, or None where the backend exposes none (CPU sim) —
+    the planner's default ``--hbm-budget-gb`` when the user names no
+    budget."""
+    limit = device_memory_stats(device)["bytes_limit"]
+    return limit / GB if limit else None
+
+
 def tree_size_bytes(tree: Any) -> int:
     """Total bytes of all array leaves (tensor-walk twin of
     ``memory.py:8-34``)."""

@@ -61,12 +61,17 @@ def analyze_strategy(name: str, *, skip_recompile: bool = False,
 
     if not skip_compiled:
         compiled = lowered.compile().as_text()
+        # strategies whose contract declares host offload get their
+        # MoveToHost/MoveToDevice sites count-checked instead of flagged
+        declared = (build.contract.host_transfers(build.ctx)
+                    if build.contract.host_transfers else None)
         findings = lint_compiled_hlo(
             compiled, mesh=build.mesh,
             allowed_axes=build.contract.axes or None,
             full_param_shapes=build.full_param_shapes,
             allow_full_param_gather=build.contract.allows_full_param_gather,
-            donate_expected=build.donate)
+            donate_expected=build.donate,
+            declared_host_transfers=declared)
         report["lint"] = [f.to_dict() for f in findings]
         for f in findings:
             print(f"[lint] {name:6s} {f.severity}: [{f.check}] {f.message}")

@@ -43,15 +43,17 @@ GB = 1 << 30
 def analyze(step, *args) -> dict:
     """Compile-time memory plan; on backends that validate HBM fit at
     compile (axon) an over-budget plan comes back as the compiler's own
-    used-vs-capacity numbers instead."""
-    import re
+    used-vs-capacity numbers instead (parsed by the shared
+    ``utils.memory.parse_hbm_oom`` — the same helper ``bench.py`` and
+    the memory planner's compiler-OOM fallback use)."""
+    from distributed_training_sandbox_tpu.utils.memory import parse_hbm_oom
     try:
         c = step.lower(*args).compile()
     except Exception as e:
-        m = re.search(r"Used ([\d.]+)G of ([\d.]+)G hbm", str(e))
-        if m:
-            return {"oom": True, "needed_gb": float(m.group(1)),
-                    "capacity_gb": float(m.group(2))}
+        oom = parse_hbm_oom(str(e))
+        if oom:
+            return {"oom": True, "needed_gb": oom[0],
+                    "capacity_gb": oom[1]}
         raise
     ma = c.memory_analysis()
     return {

@@ -4,7 +4,9 @@ NCCL-vs-ICI side-by-side.
 Discovers the run directories the telemetry layer writes
 (``<results_dir>/<run_id>/{manifest.json,steps.jsonl,summary.json}``),
 renders the strategy × payload-shape comparison table (step time,
-tokens/s, TFLOPS/device, comm %, per-step collective counts), and —
+tokens/s, TFLOPS/device, the memory column — compiler-reported or
+``~``-predicted waterline GB, ``/budget`` when one gated the run —
+comm %, per-step collective counts), and —
 with ``--baseline`` — computes regression deltas against a prior run
 dir, a runs root, a ``summary.json``, or a bench-style JSON
 (``bench_matrix_tpu.json`` / ``BENCH_*.json``), exiting nonzero when

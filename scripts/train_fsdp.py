@@ -10,6 +10,13 @@ Runs under the resilience supervisor: ``--checkpoint-dir/--checkpoint-every/
 --resume/--max-restarts`` give preemption-safe bit-exact resume of the
 dp-sharded params + opt state, data cursor included.
 
+Memory-planned: every run prints its predicted HBM waterline
+(``memory_plan/``); ``--hbm-budget-gb`` rejects predicted-over-budget
+configs before any compile, ``--auto-fit`` lets the planner pick
+remat × accum × quant × offload to fit the target batch, and
+``--offload opt|opt_act`` parks the Adam moments (and named remat saves)
+in pinned host memory under a declared transfer contract.
+
 Usage:
   python scripts/train_fsdp.py --num-steps 20 --sequence-length 8192 \
       [--model smollm3-3b|smollm3-350m|tiny] [--variant explicit|auto] \
@@ -107,11 +114,62 @@ def _leg(args, rest, cfg, ctx):
           f"variant={args.variant} reshard_after_forward={args.reshard} "
           f"mesh={dict(mesh.shape)} platform={jax.devices()[0].platform}")
 
+    # ---- memory planner: pre-flight waterline + auto-fit ---------------
+    from distributed_training_sandbox_tpu import memory_plan as MP
+    from distributed_training_sandbox_tpu.utils.memory import (
+        hbm_capacity_gb)
+    budget = cfg.hbm_budget_gb or hbm_capacity_gb()
+    state_precision = "full"
+    if cfg.auto_fit:
+        if args.variant != "explicit":
+            raise SystemExit("--auto-fit tunes the explicit step's knobs "
+                             "(remat/accum/quant/offload); drop "
+                             "--variant auto")
+        mplan = MP.plan(mcfg, batch=cfg.batch_size, seq=cfg.sequence_length,
+                        ws=ws, hbm_budget_gb=budget,
+                        priors=MP.load_bench_priors())
+        chosen = mplan.best.candidate
+        print(f"[fsdp] memory plan: {mplan.summary()}")
+        mcfg = chosen.apply_to(mcfg)
+        cfg.accum_steps = chosen.accum_steps
+        cfg.offload = chosen.offload
+        state_precision = chosen.state_precision
+    pred = MP.analytic_waterline(
+        mcfg, batch=cfg.batch_size, seq=cfg.sequence_length, ws=ws,
+        accum_steps=max(cfg.accum_steps, 1), state_precision=state_precision,
+        offload=cfg.offload, capacity_gb=budget)
+    print(f"[fsdp] predicted waterline: {pred.gb:.2f} GB/device "
+          f"(budget {budget:.2f} GB)" if budget is not None else
+          f"[fsdp] predicted waterline: {pred.gb:.2f} GB/device")
+    if pred.fits is False and not cfg.auto_fit:
+        raise SystemExit(
+            f"predicted waterline {pred.gb:.2f} GB exceeds the "
+            f"{budget:.2f} GB budget — rejected pre-compile; rerun with "
+            f"--auto-fit to search remat/accum/quant/offload, or raise "
+            f"--hbm-budget-gb")
+    if cfg.offload == "opt_act":
+        if mcfg.remat_policy not in ("save_attn", "save_dots_q8"):
+            raise SystemExit(
+                "--offload opt_act redirects NAMED remat saves to host; "
+                "pass --remat-policy save_attn (or save_dots_q8)")
+        mcfg = dataclasses.replace(mcfg, offload_activations=True)
+
     key = set_seed(cfg.seed)
     params = T.init_params(key, mcfg)
     shards = fsdp.shard_params_fsdp(params, mesh)
     del params
-    opt_state = fsdp.init_fsdp_opt_state(shards)
+    if state_precision == "int8":
+        opt_state = fsdp.init_fsdp_opt_state8(shards)
+    else:
+        opt_state = fsdp.init_fsdp_opt_state(shards)
+    oplan = MP.plan_offload(cfg.offload, opt_state)
+    if oplan.supported and cfg.offload != "none":
+        # park the Adam moments in pinned host memory at rest; the step
+        # streams them around the update under the declared contract
+        opt_state = MP.offload_tree(opt_state)
+        print(f"[fsdp] offload={cfg.offload}: {oplan.n_state_leaves} "
+              f"state leaves ({oplan.state_bytes / 2**30:.2f} GB) "
+              f"host-resident")
     print_memory_stats("fsdp-at-rest", params=shards, opt_state=opt_state)
     # resume BEFORE lowering: the contract below then checks the restored
     # state's actual sharding choreography
@@ -124,6 +182,11 @@ def _leg(args, rest, cfg, ctx):
         raise SystemExit(f"--overlap {cfg.overlap} rewires the explicit "
                          f"shard_map choreography; the auto variant's "
                          f"schedule belongs to XLA (drop --variant auto)")
+    if cfg.offload != "none" and args.variant != "explicit":
+        raise SystemExit(f"--offload {cfg.offload} streams the optimizer "
+                         f"state around the explicit step; the auto "
+                         f"variant's placement belongs to XLA (drop "
+                         f"--variant auto)")
     if cfg.accum_steps > 1 and (cfg.batch_size // ws) % cfg.accum_steps:
         raise SystemExit(f"--accum-steps {cfg.accum_steps} must divide "
                          f"the per-device batch "
@@ -131,7 +194,8 @@ def _leg(args, rest, cfg, ctx):
     if args.variant == "explicit":
         step = fsdp.make_fsdp_train_step(
             shards, mcfg, mesh, reshard_after_forward=args.reshard,
-            overlap=cfg.overlap, accum_steps=cfg.accum_steps)
+            overlap=cfg.overlap, accum_steps=cfg.accum_steps,
+            offload=cfg.offload, state_precision=state_precision)
     else:
         step = fsdp.make_fsdp_auto_train_step(shards, mcfg, mesh)
 
@@ -160,12 +224,33 @@ def _leg(args, rest, cfg, ctx):
     if args.variant == "explicit" and cfg.overlap != "ring_fused":
         from distributed_training_sandbox_tpu.analysis import (
             evaluate_contract)
-        cname = "fsdp_ring" if cfg.overlap == "ring" else "fsdp"
+        cname = ("fsdp_ring" if cfg.overlap == "ring"
+                 else "fsdp_offload" if cfg.offload != "none" else "fsdp")
         verdict = evaluate_contract(cname, counts, params=shards,
                                     mesh=mesh,
-                                    n_layers=mcfg.num_hidden_layers)
+                                    n_layers=mcfg.num_hidden_layers,
+                                    offload=oplan.to_dict())
         print(f"[fsdp] contract[{cname}]: {verdict.summary()}")
     ctx.verify_contract(verdict)
+
+    # predicted vs compiler-reported waterline for the manifest: the
+    # compile-side number costs an AOT compile, so it is only taken when
+    # the run is explicitly memory-planned (a budget or auto-fit given)
+    mem_record = {**pred.to_dict(), "budget_gb": budget,
+                  "offload": oplan.to_dict()}
+    if cfg.auto_fit:
+        mem_record["auto_fit"] = mplan.best.candidate.label()
+    if (cfg.auto_fit or cfg.hbm_budget_gb) and args.variant == "explicit":
+        try:
+            compiled = MP.predict_from_step(step, shards, opt_state,
+                                            probe, capacity_gb=budget)
+            mem_record["compiled_gb"] = round(compiled.gb, 3)
+            mem_record["compiled_source"] = compiled.source
+            print(f"[fsdp] compiler-reported waterline: "
+                  f"{compiled.gb:.2f} GB/device (predicted "
+                  f"{pred.gb:.2f})")
+        except Exception as e:  # noqa: BLE001 - prediction must not kill runs
+            mem_record["compiled_error"] = str(e)[:200]
 
     tokens_per_step = cfg.batch_size * cfg.sequence_length
     batches = packed_batches(input_ids, labels, cfg.batch_size,
@@ -184,7 +269,8 @@ def _leg(args, rest, cfg, ctx):
             contract=verdict.to_dict() if verdict else None,
             lineage=ctx.manifest_lineage(),
             extra={"variant": args.variant,
-                   "reshard_after_forward": args.reshard}) as telem:
+                   "reshard_after_forward": args.reshard,
+                   "memory_plan": mem_record}) as telem:
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight) as pump:
