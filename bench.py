@@ -355,6 +355,105 @@ def measure_checkpoint_overhead(model_name: str, seq: int, batch: int,
     }
 
 
+def measure_elastic_resume(model_name: str, seq: int, batch: int) -> dict:
+    """The elastic runtime's cost row: what an injected ws→ws/2 shrink
+    actually spends, phase by phase — failure-detection latency (the
+    heartbeat breadcrumb + the stale-timeout bound), worker-group
+    teardown (kill + reap), reshard-restore of the RunState into the
+    survivor mesh, and the first-step recompile on the new world size.
+    CPU tiny tier: the phases are real, the absolute times are the sim's."""
+    import subprocess
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.parallel import fsdp
+    from distributed_training_sandbox_tpu.resilience import (
+        Checkpointer, Heartbeat, HeartbeatMonitor, RunState)
+    from distributed_training_sandbox_tpu.utils import make_mesh
+
+    ws = len(jax.devices())
+    if ws < 2:
+        return {"config": "elastic_resume", "skipped": "world<2",
+                "devices": ws}
+    half = ws // 2
+
+    # phase 1: detection — breadcrumbed SIGKILL (instant path) and the
+    # stale-heartbeat bound (timeout_s + one poll)
+    with tempfile.TemporaryDirectory(prefix="bench-hb-") as hd:
+        for r in range(ws):
+            Heartbeat(hd, r).beat(0)
+        mon = HeartbeatMonitor(hd, ws, timeout_s=0.25)
+        Heartbeat(hd, ws - 1).mark_dead("bench")
+        t0 = time.perf_counter()
+        while ws - 1 not in mon.dead_workers():
+            pass
+        detect_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        while len(mon.dead_workers()) < 2:   # rank beats went stale
+            time.sleep(0.01)
+        stale_detect_ms = (time.perf_counter() - t0) * 1e3
+
+    # phase 2: teardown — kill + reap a group of survivor processes
+    procs = [subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(300)"])
+             for _ in range(3)]
+    time.sleep(0.05)
+    t0 = time.perf_counter()
+    for p in procs:
+        p.kill()
+    for p in procs:
+        p.wait()
+    teardown_ms = (time.perf_counter() - t0) * 1e3
+
+    # phases 3+4: reshard restore into the survivor mesh + first-step
+    # recompile at the new world size
+    cfg = getattr(T, model_name)
+    mesh = make_mesh()
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    shards = fsdp.shard_params_fsdp(params, mesh)
+    del params
+    opt = fsdp.init_fsdp_opt_state(shards)
+    mesh_small = make_mesh(devices=jax.devices()[:half], register=False)
+
+    def to_small(a):
+        if not getattr(a, "ndim", 0):
+            # scalars (Adam count) ride replicated on the survivor mesh
+            return jax.device_put(
+                jnp.asarray(a),
+                NamedSharding(mesh_small, jax.sharding.PartitionSpec()))
+        return jax.device_put(jnp.zeros(a.shape, a.dtype),
+                              NamedSharding(mesh_small, a.sharding.spec))
+    with tempfile.TemporaryDirectory(prefix="bench-elastic-") as d:
+        ck = Checkpointer(d)
+        jax.block_until_ready(shards)
+        ck.save(RunState(params=shards, opt_state=opt, step=0), wait=True)
+        like = RunState(params=jax.tree.map(to_small, shards),
+                        opt_state=jax.tree.map(to_small, opt))
+        t0 = time.perf_counter()
+        rs = ck.restore_latest(like)
+        jax.block_until_ready(rs.params)
+        restore_ms = (time.perf_counter() - t0) * 1e3
+        batch = -(-batch // half) * half
+        step = fsdp.make_fsdp_train_step(rs.params, cfg, mesh_small,
+                                         reshard_after_forward=True)
+        ids = jnp.zeros((batch, seq), jnp.int32)
+        t0 = time.perf_counter()
+        p2, o2, loss = step(rs.params, rs.opt_state, (ids, ids))
+        jax.block_until_ready(loss)
+        recompile_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "config": "elastic_resume", "model": model_name, "seq_len": seq,
+        "old_world": ws, "new_world": half,
+        "detect_ms": round(detect_ms, 2),
+        "stale_detect_ms": round(stale_detect_ms, 1),
+        "teardown_ms": round(teardown_ms, 1),
+        "restore_ms": round(restore_ms, 1),
+        "first_step_recompile_ms": round(recompile_ms, 1),
+    }
+
+
 def measure_planner_fit(model_name: str, seq: int, batch: int,
                         budget_gb: float) -> dict:
     """The memory planner's payoff row: a batch the raw matrix cannot run
@@ -441,6 +540,13 @@ def main():
         ckpt_row = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
     print(f"[bench] checkpoint_overhead {ckpt_row}", file=sys.stderr,
           flush=True)
+    try:
+        elastic_row = measure_elastic_resume(model, seq, bs)
+    except Exception as e:  # noqa: BLE001 - the bench line must print
+        elastic_row = {"config": "elastic_resume",
+                       "error": f"{type(e).__name__}: {str(e)[:120]}"}
+    print(f"[bench] elastic_resume {elastic_row}", file=sys.stderr,
+          flush=True)
     # planner payoff row: the OOM-wall batch (8× base — every matrix
     # crossing at that scale dies on HBM) auto-fitted under the device's
     # own capacity.  Only meaningful where the backend reports one.
@@ -492,6 +598,7 @@ def main():
         "pump_ab": pump_ab,
         "overlap_ab": overlap_ab,
         "checkpoint_overhead": ckpt_row,
+        "elastic_resume": elastic_row,
         "planner_fit": plan_row,
         "matrix": matrix,
     }

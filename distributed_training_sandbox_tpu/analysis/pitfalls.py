@@ -37,6 +37,15 @@ traces it), tuned so the current ``scripts/`` tree is clean at the
     that never save) marks the call line — or the line above — with a
     ``ckpt-ok`` comment.
 
+  * ``swallowed-distributed-error`` (error) — a bare ``except
+    Exception: pass`` (or ``continue``, or bare ``except:``) whose
+    ``try`` body runs collective or ``*step*`` calls: swallowed
+    distributed errors are how hangs become silent — the rank that ate
+    the exception stops participating and every peer wedges in the next
+    collective with no diagnosis.  Handlers that *do* something (log,
+    re-raise, return a fallback) are fine; a deliberate swallow marks
+    the ``except`` line — or the line above — with ``# swallow-ok``.
+
   * ``gather-in-step`` (error) — a monolithic ``all_gather`` inside a
     ``*step*`` function in a module that also has a ring variant in
     scope (``ring_all_gather`` / ``all_gather_matmul``): the overlap
@@ -144,6 +153,7 @@ class _Visitor(ast.NodeVisitor):
         self.has_ckpt_guard = False
         self.has_ring_variant = False
         self.gathers_in_step: list[tuple[int, str]] = []
+        self.swallowed: list[tuple[int, str]] = []
 
     # -- context tracking -------------------------------------------------
     def _visit_function(self, node):
@@ -174,6 +184,31 @@ class _Visitor(ast.NodeVisitor):
         self._loop_depth -= 1
 
     visit_For = visit_While = _visit_loop
+
+    def visit_Try(self, node):
+        """The swallowed-distributed-error check: a handler that
+        catches everything and does nothing, wrapped around collective
+        or ``*step*`` calls."""
+        risky = self._distributed_call_in(node.body)
+        if risky:
+            for h in node.handlers:
+                if not _catches_everything(h) or not _body_is_noop(h.body):
+                    continue
+                self.swallowed.append((h.lineno, risky))
+        self.generic_visit(node)
+
+    def _distributed_call_in(self, body) -> str:
+        """Dotted name of the first collective / *step* call under
+        ``body`` ('' if none)."""
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                chain = _attr_chain(sub.func)
+                leaf = chain.rsplit(".", 1)[-1]
+                if leaf in COLLECTIVE_FNS or "step" in leaf.lower():
+                    return chain or leaf
+        return ""
 
     # -- checks -----------------------------------------------------------
     def visit_Call(self, node: ast.Call):
@@ -260,6 +295,23 @@ class _Visitor(ast.NodeVisitor):
                 f"params/opt-state are double-buffered every step"))
 
 
+def _catches_everything(handler: ast.ExceptHandler) -> bool:
+    """bare ``except:`` or ``except Exception/BaseException``."""
+    if handler.type is None:
+        return True
+    name = _attr_chain(handler.type).rsplit(".", 1)[-1]
+    return name in ("Exception", "BaseException")
+
+
+def _body_is_noop(body) -> bool:
+    """Only ``pass``/``continue`` (docstring-style bare constants too) —
+    the handler observes the failure and discards it."""
+    return all(isinstance(s, (ast.Pass, ast.Continue))
+               or (isinstance(s, ast.Expr)
+                   and isinstance(s.value, ast.Constant))
+               for s in body)
+
+
 def _annotate_assignments(tree: ast.AST) -> None:
     """Tag each Call node with the simple name it's assigned to (for the
     donation check's '*step*' heuristic)."""
@@ -303,6 +355,16 @@ def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
                 f"manager in utils.checkpoint.closing(...) (or use "
                 f"resilience.Checkpointer), or mark a restore-only "
                 f"open with '# ckpt-ok'"))
+    for line, chain in v.swallowed:
+        if _pragma(line, "swallow-ok"):
+            continue
+        findings.append(PitfallFinding(
+            path, line, "swallowed-distributed-error", SEV_ERROR,
+            f"except-and-discard around {chain}() — a swallowed "
+            f"distributed error turns into a silent hang: the rank "
+            f"that ate it stops participating and every peer wedges "
+            f"in the next collective; handle or re-raise (or mark a "
+            f"deliberate swallow with '# swallow-ok')"))
     if v.has_ring_variant:
         for line, chain in v.gathers_in_step:
             if _pragma(line, "gather-ok"):
@@ -330,10 +392,20 @@ def lint_file(path) -> list[PitfallFinding]:
     return lint_source(p.read_text(), str(p))
 
 
-def lint_tree(root) -> list[PitfallFinding]:
-    """Lint every ``*.py`` under ``root`` (non-recursive for a scripts/
-    dir, recursive otherwise is overkill — keep it flat like scripts/)."""
+def lint_tree(root, *, recursive: bool = False,
+              checks: set[str] | None = None) -> list[PitfallFinding]:
+    """Lint every ``*.py`` under ``root``.  Flat by default (the
+    scripts/ layout); ``recursive=True`` walks a package tree.
+    ``checks`` restricts the findings to those check names — the
+    package tree gets only the swallowed-distributed-error check (its
+    internals legitimately trip the driver-shaped heuristics, e.g.
+    collective wrappers outside shard_map)."""
     findings = []
-    for p in sorted(Path(root).glob("*.py")):
+    pattern = "**/*.py" if recursive else "*.py"
+    for p in sorted(Path(root).glob(pattern)):
+        if "__pycache__" in p.parts:
+            continue
         findings.extend(lint_file(p))
+    if checks is not None:
+        findings = [f for f in findings if f.check in checks]
     return findings

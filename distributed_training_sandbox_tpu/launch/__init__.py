@@ -5,5 +5,6 @@ TPU-native twin of ``modal_utils.py`` + ``DDP/scripts/profile.sh`` +
 
 from . import launcher  # noqa: F401
 from .launcher import (  # noqa: F401
-    LaunchConfig, RunResult, STRATEGY_SCRIPTS, build_launch_command,
-    parse_device_spec, run_training, sync_traces, view_command)
+    GroupResult, LaunchConfig, RunResult, STRATEGY_SCRIPTS,
+    build_launch_command, parse_device_spec, run_elastic_group,
+    run_training, sync_traces, view_command)

@@ -42,6 +42,12 @@ def _build_config(args) -> LaunchConfig:
         cfg.device_spec = args.devices
     if getattr(args, "nprocs", None):
         cfg.nprocs = args.nprocs
+    if getattr(args, "elastic", None):
+        cfg.elastic = True
+    if getattr(args, "group_restarts", None) is not None:
+        cfg.group_restarts = args.group_restarts
+    if getattr(args, "heartbeat_timeout", None) is not None:
+        cfg.heartbeat_timeout = args.heartbeat_timeout
     return cfg
 
 
@@ -63,6 +69,16 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--nprocs", type=int, default=None,
                         help="worker processes (torchrun --nproc_per_node"
                              " twin); needs a cpu:<k> device spec")
+        sp.add_argument("--elastic", action="store_true", default=None,
+                        help="with --nprocs: on worker death, shrink to "
+                             "the survivors and relaunch with --resume "
+                             "(heartbeat-monitored worker group)")
+        sp.add_argument("--group-restarts", type=int, default=None,
+                        help="elastic: worker-group relaunch budget "
+                             "(default 1)")
+        sp.add_argument("--heartbeat-timeout", type=float, default=None,
+                        help="elastic: seconds without a worker heartbeat "
+                             "before it is declared dead (default 10)")
         sp.add_argument("--dry-run", action="store_true",
                         help="print the command + trace dir, don't execute")
         sp.add_argument("extra", nargs=argparse.REMAINDER,

@@ -116,6 +116,16 @@ class LaunchConfig:
     trace_output_dir: str | os.PathLike = "./traces"   # sync destination
     env: dict = field(default_factory=dict)
     extra_args: list = field(default_factory=list)
+    #: elastic worker groups (nprocs > 1): on a worker death — process
+    #: exit, SIGKILL, or heartbeat staleness past ``heartbeat_timeout``
+    #: seconds — tear down the group, shrink to the largest power-of-two
+    #: worker count the survivors can fill, and relaunch with --resume
+    #: appended (up to ``group_restarts`` times).  The training script's
+    #: own checkpoint flags (--checkpoint-dir/--checkpoint-every) ride
+    #: in ``extra_args``.
+    elastic: bool = False
+    group_restarts: int = 1
+    heartbeat_timeout: float = 10.0
 
     @classmethod
     def from_config(cls, config: dict | str | os.PathLike) -> "LaunchConfig":
@@ -149,6 +159,12 @@ class LaunchConfig:
             kw["trace_root"] = trace["root"]
         if "local_dir" in trace:
             kw["trace_output_dir"] = trace["local_dir"]
+        if "elastic" in devices:
+            kw["elastic"] = bool(devices["elastic"])
+        if "group_restarts" in devices:
+            kw["group_restarts"] = int(devices["group_restarts"])
+        if "heartbeat_timeout" in devices:
+            kw["heartbeat_timeout"] = float(devices["heartbeat_timeout"])
         kw["env"] = dict(launcher.get("env", {}))
         kw["extra_args"] = list(launcher.get("args", []))
         return cls(**kw)
@@ -248,7 +264,9 @@ def run_training(config: LaunchConfig, *, script: str | None = None,
     if dry_run:
         return RunResult(run_id, trace_dir, cmd, 0)
     trace_dir.mkdir(parents=True, exist_ok=True)
-    if nprocs > 1:
+    if nprocs > 1 and config.elastic:
+        returncode = run_elastic_group(config, cmd, env, trace_dir, nprocs)
+    elif nprocs > 1:
         returncode = _run_multiprocess(config, cmd, env, trace_dir, nprocs)
     else:
         returncode = subprocess.run(cmd, env=env,
@@ -268,14 +286,58 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_multiprocess(config: LaunchConfig, cmd: list[str], env: dict,
-                      trace_dir: Path, nprocs: int) -> int:
+def _exit_code(raw: int) -> int:
+    """Propagatable exit code: signal-killed children report negative
+    codes — map -SIG to the shell convention 128+SIG so the launcher's
+    own exit status says *which* signal, not a flattened 1."""
+    return 128 - raw if raw < 0 else raw
+
+
+def _die_with_parent():
+    """preexec_fn: workers get SIGTERM when the coordinator process
+    dies (Linux PR_SET_PDEATHSIG) — a crashed/killed launcher must not
+    leave stragglers spinning in collectives.  Best-effort: on
+    platforms without prctl the group-kill paths below still cover
+    every exit the coordinator survives long enough to handle."""
+    try:
+        import ctypes
+        import signal as _signal
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, _signal.SIGTERM)   # 1 == PR_SET_PDEATHSIG
+    except Exception:  # noqa: BLE001 - portability fallback
+        pass
+
+
+@dataclass
+class GroupResult:
+    """Outcome of one worker-group attempt: the propagatable exit code
+    (first nonzero worker's, 128+SIG for signal deaths), which ranks
+    failed, and how long detection took from first poll of the dead
+    worker (the bounded-interval contract of the failure detector)."""
+    returncode: int
+    failed_ranks: list
+    detect_s: float | None = None
+
+
+def _run_worker_group(config: LaunchConfig, cmd: list[str], env: dict,
+                      trace_dir: Path, nprocs: int,
+                      heartbeat_dir: Path | None = None) -> GroupResult:
     """The torchrun contract: coordinator address + N worker processes,
     each joining one global mesh via the DTS_* env consumed in
     ``utils.mesh.auto_initialize_from_env``.  Requires a ``cpu:K`` device
     spec (K simulated devices per process → an N·K-device mesh); real
     multi-host TPU launches use one process per host with JAX's own
     topology discovery instead.
+
+    Failure detection in the coordinator path: every worker is polled
+    for process death AND — when ``heartbeat_dir`` is set — probed
+    through :class:`~..resilience.elastic.HeartbeatMonitor`, so a rank
+    that is alive-but-wedged (or SIGKILLed with a ``.dead`` breadcrumb)
+    is detected within ``config.heartbeat_timeout`` seconds instead of
+    the group hanging in collectives until the full launch timeout.
+    On any worker failure the survivors are killed promptly; every exit
+    path (timeout, exception, coordinator death via PDEATHSIG) reaps
+    the group — stragglers cannot outlive the launch.
 
     Worker stdout/stderr stream to ``<trace_dir>/worker_<i>.log``;
     worker 0's log is echoed on completion (the rank-0-prints-the-report
@@ -300,23 +362,34 @@ def _run_multiprocess(config: LaunchConfig, cmd: list[str], env: dict,
             base_env["XLA_FLAGS"] = shlex.join(kept)
         else:
             del base_env["XLA_FLAGS"]
+    monitor = None
+    if heartbeat_dir is not None:
+        from ..resilience.elastic import HeartbeatMonitor
+        heartbeat_dir = Path(heartbeat_dir)
+        monitor = HeartbeatMonitor(heartbeat_dir, nprocs,
+                                   timeout_s=config.heartbeat_timeout)
     procs, logs = [], []
     for pid in range(nprocs):
         wenv = {**base_env, "DTS_COORDINATOR": coord,
                 "DTS_NUM_PROCESSES": str(nprocs),
                 "DTS_PROCESS_ID": str(pid)}
+        if heartbeat_dir is not None:
+            wenv["DTS_HEARTBEAT_DIR"] = str(heartbeat_dir)
         log = (trace_dir / f"worker_{pid}.log").open("w")
         logs.append(log)
-        procs.append(subprocess.Popen(cmd, env=wenv, stdout=log,
-                                      stderr=subprocess.STDOUT))
+        procs.append(subprocess.Popen(
+            cmd, env=wenv, stdout=log, stderr=subprocess.STDOUT,
+            preexec_fn=_die_with_parent if os.name == "posix" else None))
     import time as _time
     deadline = (_time.monotonic() + config.timeout
                 if config.timeout else None)
-    rc = 0
+    rc, failed, detect_s = 0, [], None
+    t_start = _time.monotonic()
     try:
         # poll ALL workers: if one dies during bring-up the survivors
         # block in collectives until timeout — kill the group as soon
-        # as any worker exits nonzero instead of waiting it out
+        # as any worker exits nonzero (or goes heartbeat-dead) instead
+        # of waiting it out
         live = dict(enumerate(procs))
         while live:
             if deadline and _time.monotonic() > deadline:
@@ -327,24 +400,34 @@ def _run_multiprocess(config: LaunchConfig, cmd: list[str], env: dict,
                     continue
                 del live[pid]
                 # signal-killed workers return NEGATIVE codes — any
-                # nonzero (either sign) must fail the run
+                # nonzero (either sign) must fail the run, and the
+                # FIRST failure's code is the one the launch reports
                 if code != 0:
-                    rc = 1
-                    for q in live.values():
-                        q.kill()
-                    for q in live.values():
-                        q.wait()
+                    if not failed:
+                        rc = _exit_code(code)
+                        detect_s = _time.monotonic() - t_start
+                    failed.append(pid)
                     live.clear()
                     break
+            if live and monitor is not None:
+                dead = [r for r in monitor.dead_workers() if r in live]
+                if dead:
+                    rc = 128 + 9   # treated as SIGKILLed
+                    detect_s = _time.monotonic() - t_start
+                    failed.extend(dead)
+                    live.clear()
             if live:
                 _time.sleep(0.1)
     except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        for p in procs:   # reap, don't leave zombies
-            p.wait()
         raise
     finally:
+        # orphan cleanup on EVERY exit path (failure, timeout,
+        # KeyboardInterrupt, coordinator unwinding): kill + reap
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait()
         for log in logs:
             log.close()
     w0 = trace_dir / "worker_0.log"
@@ -354,7 +437,60 @@ def _run_multiprocess(config: LaunchConfig, cmd: list[str], env: dict,
         if p.returncode:
             print(f"[launch] worker {pid} exit {p.returncode} — see "
                   f"{trace_dir / f'worker_{pid}.log'}", file=sys.stderr)
-    return rc
+    return GroupResult(returncode=rc, failed_ranks=sorted(set(failed)),
+                       detect_s=detect_s)
+
+
+def _run_multiprocess(config: LaunchConfig, cmd: list[str], env: dict,
+                      trace_dir: Path, nprocs: int) -> int:
+    """Back-compat shim over :func:`_run_worker_group`."""
+    return _run_worker_group(config, cmd, env, trace_dir, nprocs).returncode
+
+
+def run_elastic_group(config: LaunchConfig, cmd: list[str], env: dict,
+                      trace_dir: Path, nprocs: int) -> int:
+    """The coordinator-side elastic loop: launch the worker group with
+    heartbeat monitoring; on a worker death shrink to the largest
+    power-of-two count the survivors can fill and relaunch with
+    ``--resume`` appended (the workers' own resilience runtime reshards
+    the latest RunState into the smaller mesh).  Gives up when the
+    restart budget is spent or the world cannot shrink further."""
+    from ..resilience.elastic import shrink_plan, WorkerLost
+    world, attempt = nprocs, 0
+    cmd = list(cmd)
+    while True:
+        hb_dir = Path(trace_dir) / f"heartbeats-{attempt}"
+        res = _run_worker_group(config, cmd, env, Path(trace_dir), world,
+                                heartbeat_dir=hb_dir)
+        if res.returncode == 0:
+            return 0
+        if attempt >= config.group_restarts:
+            print(f"[launch] elastic: restart budget "
+                  f"({config.group_restarts}) exhausted", file=sys.stderr)
+            return res.returncode
+        lost = res.failed_ranks or [world - 1]
+        try:
+            if len(set(lost)) >= world:
+                # the WHOLE group went heartbeat-dead — a group-wide
+                # wedge (hung collective), not a named worker loss:
+                # halve the world, the StepTimeoutError policy
+                plan = shrink_plan(world, [], force_shrink=True)
+            else:
+                plan = shrink_plan(world, lost)
+        except WorkerLost:
+            print(f"[launch] elastic: no viable group below {world} "
+                  f"workers", file=sys.stderr)
+            return res.returncode
+        detect = (f" (detected in {res.detect_s:.1f}s)"
+                  if res.detect_s is not None else "")
+        print(f"[launch] elastic: worker(s) {lost} lost{detect}; "
+              f"relaunching {plan.old_world} -> {plan.new_world} "
+              f"workers with --resume "
+              f"[{attempt + 1}/{config.group_restarts}]")
+        world = plan.new_world
+        if "--resume" not in cmd:
+            cmd.append("--resume")
+        attempt += 1
 
 
 def sync_traces(config: LaunchConfig, run_id: str | None = None,

@@ -18,8 +18,14 @@ is the missing run-level half, layered over the existing Orbax wrapper
     and exits cleanly; ``--max-restarts`` with backoff resumes from the
     latest step and records restart lineage in ``manifest.json``;
   * :mod:`faults` — deterministic fault injection (crash-at-step-N,
-    simulated preemption, truncated/corrupted checkpoint files) behind
-    the ``--inject-fault`` debug flag and the test suite.
+    simulated preemption, worker SIGKILL, hung/straggling ranks,
+    truncated/corrupted checkpoint files) behind the ``--inject-fault``
+    debug flag and the test suite;
+  * :mod:`elastic` — the elastic mesh runtime: per-worker heartbeat
+    failure detection, shrink-to-survivors resume (rebuild a smaller
+    mesh, reshard-restore, bitwise-pinned continuation), and the
+    collective watchdog that converts hung steps into diagnosable
+    :class:`StepTimeoutError` instead of silent deadlocks.
 
 The headline guarantee, pinned by ``tests/test_resilience.py`` on the
 8-way CPU mesh: preempt a run at step k, resume it, and the concatenated
@@ -47,4 +53,15 @@ from .supervisor import (  # noqa: F401
     Preempted,
     ResilienceContext,
     Supervisor,
+)
+from .elastic import (  # noqa: F401
+    ElasticPlan,
+    ElasticSupervisor,
+    Heartbeat,
+    HeartbeatMonitor,
+    StepTimeoutError,
+    Watchdog,
+    WorkerLost,
+    read_heartbeats,
+    shrink_plan,
 )

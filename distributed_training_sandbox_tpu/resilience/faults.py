@@ -14,6 +14,21 @@ themselves become deterministic.  This module is that harness:
   * ``crash@N:label`` / ``preempt@N:label`` — scope the fault to one
     named leg of a multi-leg driver (the zero A/B scripts' ``baseline``
     / ``sharded`` legs);
+  * ``--inject-fault kill_worker@N:k`` — the elastic-runtime fault: at
+    step N, worker rank ``k`` dies without warning.  Under the
+    multi-process launcher the targeted worker drops a heartbeat
+    ``.dead`` breadcrumb and SIGKILLs itself; in the single-process
+    CPU-mesh sim it raises :class:`~.elastic.WorkerLost` — the
+    deterministic twin the :class:`~.elastic.ElasticSupervisor` shrink
+    path consumes;
+  * ``--inject-fault hang@N`` — wedge the collective watchdog at step
+    N, the deterministic form of a rank dying *inside* a collective:
+    the next pump sync point blocks forever and the watchdog converts
+    it into a :class:`~.elastic.StepTimeoutError` (needs
+    ``--watchdog-timeout`` > 0, enforced loudly);
+  * ``--inject-fault slow@N:ms`` — a straggler: sleep ``ms`` at step N.
+    Must NOT trip the heartbeat monitor (its timeout bounds detection
+    of *death*, not slowness);
   * :func:`truncate_checkpoint` / :func:`corrupt_checkpoint` — tamper
     with a saved step's files on disk, for pinning that a torn restore
     fails with a readable error instead of a tensorstore traceback.
@@ -31,8 +46,11 @@ import time
 from dataclasses import dataclass
 from pathlib import Path
 
-FAULT_KINDS = ("crash", "preempt")
-_SPEC_RE = re.compile(r"^(?P<kind>[a-z]+)@(?P<step>\d+)(?::(?P<target>[\w-]+))?$")
+FAULT_KINDS = ("crash", "preempt", "kill_worker", "hang", "slow")
+#: kinds whose ``:target`` suffix is an integer (worker rank /
+#: milliseconds), not a leg label
+_INT_TARGET_KINDS = ("kill_worker", "slow")
+_SPEC_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)(?::(?P<target>[\w-]+))?$")
 
 
 class InjectedCrash(RuntimeError):
@@ -62,9 +80,15 @@ def parse_fault_spec(spec: str | None) -> FaultSpec | None:
         raise SystemExit(
             f"--inject-fault {spec!r} not understood: expected "
             f"KIND@STEP[:leg] with KIND in {'/'.join(FAULT_KINDS)} "
-            f"(e.g. crash@5, preempt@8:sharded)")
-    return FaultSpec(kind=m.group("kind"), step=int(m.group("step")),
-                     target=m.group("target") or "")
+            f"(e.g. crash@5, preempt@8:sharded, kill_worker@5:3, "
+            f"hang@4, slow@3:50)")
+    kind, target = m.group("kind"), m.group("target") or ""
+    if kind in _INT_TARGET_KINDS and target and not target.isdigit():
+        what = "worker rank" if kind == "kill_worker" else "milliseconds"
+        raise SystemExit(
+            f"--inject-fault {spec!r}: {kind}'s :target is a {what} "
+            f"(an integer), got {target!r}")
+    return FaultSpec(kind=kind, step=int(m.group("step")), target=target)
 
 
 class FaultInjector:
@@ -74,20 +98,55 @@ class FaultInjector:
         self.spec = spec
         self.fired = False
 
-    def check(self, step: int, shutdown=None, scope: str = "") -> None:
+    def check(self, step: int, shutdown=None, scope: str = "",
+              watchdog=None) -> None:
         """Fire the configured fault if ``step``/``scope`` match.
         ``crash`` raises; ``preempt`` delivers SIGTERM to this process
         and returns once the handler has observed it (deterministic for
-        the caller's next ``shutdown.requested`` check)."""
+        the caller's next ``shutdown.requested`` check); ``kill_worker``
+        SIGKILLs the targeted spawned worker (or raises
+        :class:`~.elastic.WorkerLost` in the single-process sim);
+        ``hang`` wedges ``watchdog``; ``slow`` sleeps its target ms."""
         if self.fired or self.spec is None or step != self.spec.step:
             return
-        if self.spec.target and self.spec.target != scope:
+        if self.spec.kind in ("crash", "preempt") \
+                and self.spec.target and self.spec.target != scope:
             return
         self.fired = True
-        if self.spec.kind == "crash":
+        kind = self.spec.kind
+        if kind == "crash":
             raise InjectedCrash(
                 f"injected crash at step {step}"
                 + (f" ({scope})" if scope else ""))
+        if kind == "slow":
+            time.sleep(int(self.spec.target or "100") / 1000.0)
+            return
+        if kind == "hang":
+            if watchdog is None:
+                raise SystemExit(
+                    f"--inject-fault hang@{step} needs a collective "
+                    f"watchdog — pass --watchdog-timeout SECONDS > 0, "
+                    f"otherwise the injected hang would block forever")
+            watchdog.wedge()
+            return
+        if kind == "kill_worker":
+            rank = int(self.spec.target or "0")
+            proc_rank = os.environ.get("DTS_PROCESS_ID")
+            if proc_rank is not None:
+                # real spawned worker: only the targeted rank dies —
+                # breadcrumb first so the coordinator detects instantly
+                if int(proc_rank) == rank:
+                    hb = os.environ.get("DTS_HEARTBEAT_DIR")
+                    if hb:
+                        from .elastic import Heartbeat
+                        Heartbeat(hb, rank).mark_dead(
+                            f"kill_worker@{step}")
+                    os.kill(os.getpid(), signal.SIGKILL)
+                return
+            # single-process CPU-mesh sim: the deterministic twin of a
+            # SIGKILLed worker is losing that rank's devices mid-run
+            from .elastic import WorkerLost
+            raise WorkerLost([rank], step=step, trigger="kill_worker")
         os.kill(os.getpid(), signal.SIGTERM)
         # CPython runs the handler between bytecodes; wait until the
         # flag is visible so the caller's very next check sees it

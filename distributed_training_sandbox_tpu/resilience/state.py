@@ -202,40 +202,54 @@ class Checkpointer:
 
     # ---- restore --------------------------------------------------------
     def restore_latest(self, like: RunState) -> RunState | None:
-        """Latest RunState, or None when the directory holds no steps
-        (a resume of a run that never reached its first save starts
-        fresh).  Verifies the saved fingerprint (seed/precision/batch)
-        against this run's — a silently different config must not wear
-        a restored trajectory."""
+        """Latest *intact* RunState, or None when the directory holds no
+        steps (a resume of a run that never reached its first save
+        starts fresh).  Verifies the saved fingerprint (seed/precision/
+        batch) against this run's — a silently different config must not
+        wear a restored trajectory.  A torn or corrupted newest step
+        (the shape a SIGKILL mid-async-save leaves behind) is SKIPPED
+        with a warning and the previous intact step restored instead —
+        an elastic resume after a torn save self-heals; only when every
+        step is corrupt does the error propagate."""
         if not os.path.isdir(self.directory):
             return None
         self.mgr.wait_until_finished()
-        step = C.latest_step(self.mgr)
-        if step is None:
+        steps = sorted(self.mgr.all_steps() or [], reverse=True)
+        if not steps:
             return None
-        meta = _read_meta(self.directory, step) or {}
-        saved_fp = meta.get("fingerprint") or {}
-        for k, want in self.fingerprint.items():
-            have = saved_fp.get(k)
-            if have is not None and want is not None and have != want:
-                raise SystemExit(
-                    f"cannot resume from {self.directory}: checkpoint "
-                    f"was written with {k}={have!r}, this run has "
-                    f"{k}={want!r} — resuming would silently fork the "
-                    f"trajectory (rerun with the original {k}, or a "
-                    f"fresh --checkpoint-dir)")
-        state = restore_run_state(self.mgr, like=like, step=step)
-        if like.prng_key is not None and state.prng_key is not None:
-            import numpy as np
-            if not np.array_equal(np.asarray(like.prng_key),
-                                  np.asarray(state.prng_key)):
-                raise SystemExit(
-                    f"cannot resume from {self.directory}: the "
-                    f"checkpointed PRNG root key differs from this "
-                    f"run's (different --seed?) — the resumed data/"
-                    f"init stream would not match the original run")
-        self._saved_steps.add(step)
-        return state
+        last_err: CheckpointCorruptError | None = None
+        for step in steps:
+            meta = _read_meta(self.directory, step) or {}
+            saved_fp = meta.get("fingerprint") or {}
+            for k, want in self.fingerprint.items():
+                have = saved_fp.get(k)
+                if have is not None and want is not None and have != want:
+                    raise SystemExit(
+                        f"cannot resume from {self.directory}: checkpoint "
+                        f"was written with {k}={have!r}, this run has "
+                        f"{k}={want!r} — resuming would silently fork the "
+                        f"trajectory (rerun with the original {k}, or a "
+                        f"fresh --checkpoint-dir)")
+            try:
+                state = restore_run_state(self.mgr, like=like, step=step)
+            except CheckpointCorruptError as e:
+                print(f"[resilience] WARNING: checkpoint step {step} in "
+                      f"{self.directory} is torn or corrupt — skipping it"
+                      f" and falling back to the previous intact step")
+                last_err = e
+                continue
+            if like.prng_key is not None and state.prng_key is not None:
+                import numpy as np
+                if not np.array_equal(np.asarray(like.prng_key),
+                                      np.asarray(state.prng_key)):
+                    raise SystemExit(
+                        f"cannot resume from {self.directory}: the "
+                        f"checkpointed PRNG root key differs from this "
+                        f"run's (different --seed?) — the resumed data/"
+                        f"init stream would not match the original run")
+            self._saved_steps.add(step)
+            return state
+        raise last_err
 
     # ---- save policy ----------------------------------------------------
     def maybe_save(self, i: int, state_fn, *, synced: bool) -> bool:

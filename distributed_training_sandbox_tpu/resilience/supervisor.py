@@ -102,7 +102,10 @@ class ResilienceContext:
                  injector: FaultInjector | None = None,
                  shutdown: GracefulShutdown | None = None,
                  lineage: dict | None = None, label: str = "",
-                 supervisor: "Supervisor | None" = None):
+                 supervisor: "Supervisor | None" = None,
+                 world_size: int | None = None,
+                 watchdog_timeout: float = 0.0,
+                 heartbeat_dir: str | None = None):
         self.attempt = attempt
         self.resume = resume
         self.ckptr = ckptr
@@ -111,8 +114,14 @@ class ResilienceContext:
         self.label = label
         self._lineage = lineage if lineage is not None else {}
         self._sup = supervisor
+        self.world_size = int(world_size) if world_size else None
+        self.watchdog_timeout = float(watchdog_timeout or 0.0)
+        self.heartbeat_dir = heartbeat_dir
         self.start_step = 0
         self.restored: RunState | None = None
+        self.last_verdict = None
+        self._watchdog = None
+        self._heartbeat = None
         self._restored_losses: list[float] = []
         self._state_fn = None
         self._last_step: int | None = None
@@ -140,9 +149,53 @@ class ResilienceContext:
                                fingerprint=self.ckptr.fingerprint)
             if self.ckptr else None,
             injector=self.injector, shutdown=self.shutdown,
-            lineage=self._lineage, label=label, supervisor=self._sup)
+            lineage=self._lineage, label=label, supervisor=self._sup,
+            world_size=self.world_size,
+            watchdog_timeout=self.watchdog_timeout,
+            heartbeat_dir=self.heartbeat_dir)
         self._children.append(child)
         return child
+
+    # ---- elastic mesh -----------------------------------------------------
+    def mesh_devices(self):
+        """The device subset this attempt's mesh is built from: the
+        first ``world_size`` devices (the deterministic survivor slice
+        after an elastic shrink), or None when the run owns every
+        visible device — ``make_mesh(devices=None)`` is the default."""
+        if not self.world_size:
+            return None
+        import jax
+        devs = jax.devices()
+        if self.world_size > len(devs):
+            raise SystemExit(
+                f"--world-size {self.world_size} exceeds the "
+                f"{len(devs)} visible devices")
+        return devs[:self.world_size]
+
+    def make_watchdog(self):
+        """The collective watchdog the driver hands the step pump:
+        None when ``--watchdog-timeout`` is unset (zero-cost default);
+        otherwise a :class:`~.elastic.Watchdog` whose timeout error
+        carries this context's last contract verdict.  Also the wedge
+        target of the deterministic ``hang@N`` fault."""
+        if self.watchdog_timeout > 0 and self._watchdog is None:
+            from .elastic import Watchdog
+            self._watchdog = Watchdog(
+                self.watchdog_timeout,
+                context=lambda: {
+                    "contract": self.last_verdict.summary()
+                    if self.last_verdict is not None else None})
+        return self._watchdog
+
+    def _beat(self, step: int) -> None:
+        if not self.heartbeat_dir:
+            return
+        if self._heartbeat is None:
+            from .elastic import Heartbeat
+            self._heartbeat = Heartbeat(
+                self.heartbeat_dir,
+                rank=int(os.environ.get("DTS_PROCESS_ID", "0")))
+        self._heartbeat.beat(step)
 
     # ---- resume ----------------------------------------------------------
     def restore(self, like: RunState) -> RunState | None:
@@ -175,6 +228,7 @@ class ResilienceContext:
         a resume whose choreography changed (different mesh/sharding
         than the checkpoint expects) must fail loudly, and the verdict
         is recorded in the lineage the manifest captures."""
+        self.last_verdict = verdict   # the watchdog attaches this
         if verdict is None or self.restored is None:
             return
         self._scope_lineage()["resume_contract"] = {
@@ -191,7 +245,8 @@ class ResilienceContext:
         """Top-of-iteration check: fires any due injected fault (crash
         raises from here), then reports whether a preemption notice has
         arrived — the loop breaks and ``finalize`` handles the rest."""
-        self.injector.check(i, shutdown=self.shutdown, scope=self.label)
+        self.injector.check(i, shutdown=self.shutdown, scope=self.label,
+                            watchdog=self._watchdog)
         if self.shutdown.requested:
             self._preempted_at = i - 1
             return True
@@ -204,6 +259,7 @@ class ResilienceContext:
         actually happens."""
         self._state_fn = state_fn
         self._last_step = i
+        self._beat(i)
         if self.ckptr is not None:
             self.ckptr.maybe_save(i, lambda: self._stamped(state_fn()),
                                   synced=synced)
@@ -260,6 +316,9 @@ class ResilienceContext:
             "attempt": self.attempt,
             "segments": list(self._sup.segments) if self._sup else [],
         })
+        transitions = getattr(self._sup, "transitions", None)
+        if transitions:
+            state.lineage["mesh_transitions"] = list(transitions)
         return state
 
     def _record_segment(self, telem, status: str) -> None:
@@ -283,11 +342,17 @@ class Supervisor:
     a preemption returns a clean ``{"status": "preempted", ...}`` result
     — the preemption contract is a clean exit, not a traceback."""
 
+    #: failure types whose handling may restart the loop (the elastic
+    #: subclass widens this with WorkerLost / StepTimeoutError)
+    _restartable: tuple = (InjectedCrash,)
+
     def __init__(self, *, checkpoint_dir=None, checkpoint_every: int = 0,
                  resume: bool = False, max_restarts: int = 0,
                  fault: str | None = None, strategy: str = "",
                  fingerprint: dict | None = None, keep: int = 3,
-                 backoff_s: float = 0.25):
+                 backoff_s: float = 0.25, world_size: int | None = None,
+                 watchdog_timeout: float = 0.0,
+                 heartbeat_dir: str | None = None):
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
         self.resume = resume
@@ -297,6 +362,9 @@ class Supervisor:
         self.fingerprint = dict(fingerprint or {})
         self.keep = keep
         self.backoff_s = backoff_s
+        self.world_size = int(world_size) if world_size else None
+        self.watchdog_timeout = float(watchdog_timeout or 0.0)
+        self.heartbeat_dir = heartbeat_dir
         self.segments: list[dict] = []
         self._injector = FaultInjector(self.spec)   # shared: one-shot
 
@@ -308,12 +376,21 @@ class Supervisor:
               "batch_size": getattr(cfg, "batch_size", None),
               "precision": getattr(cfg, "precision", None)}
         fp.update(extra_fingerprint or {})
-        return cls(checkpoint_dir=getattr(cfg, "checkpoint_dir", None),
-                   checkpoint_every=getattr(cfg, "checkpoint_every", 0),
-                   resume=getattr(cfg, "resume", False),
-                   max_restarts=getattr(cfg, "max_restarts", 0),
-                   fault=getattr(cfg, "inject_fault", None),
-                   strategy=strategy, fingerprint=fp)
+        klass = cls
+        if getattr(cfg, "elastic", False):
+            from .elastic import ElasticSupervisor
+            klass = ElasticSupervisor
+        return klass(
+            checkpoint_dir=getattr(cfg, "checkpoint_dir", None),
+            checkpoint_every=getattr(cfg, "checkpoint_every", 0),
+            resume=getattr(cfg, "resume", False),
+            max_restarts=getattr(cfg, "max_restarts", 0),
+            fault=getattr(cfg, "inject_fault", None),
+            strategy=strategy, fingerprint=fp,
+            world_size=getattr(cfg, "world_size", 0) or None,
+            watchdog_timeout=getattr(cfg, "watchdog_timeout", 0.0) or 0.0,
+            heartbeat_dir=getattr(cfg, "heartbeat_dir", None)
+            or os.environ.get("DTS_HEARTBEAT_DIR"))
 
     @property
     def active(self) -> bool:
@@ -334,7 +411,24 @@ class Supervisor:
         return ResilienceContext(
             attempt=attempt, resume=self.resume or attempt > 0,
             ckptr=ckptr, injector=self._injector, shutdown=shutdown,
-            lineage=lineage, supervisor=self)
+            lineage=lineage, supervisor=self,
+            world_size=self.world_size,
+            watchdog_timeout=self.watchdog_timeout,
+            heartbeat_dir=self.heartbeat_dir)
+
+    def _on_failure(self, e, ctx, attempt: int) -> bool:
+        """Handle one restartable failure; True = restart, False =
+        re-raise (budget exhausted / unrecoverable)."""
+        if attempt >= self.max_restarts:
+            return False
+        self.segments.append({
+            "attempt": attempt, "scope": "", "run_id": None,
+            "start_step": ctx.start_step,
+            "end_step": ctx._last_step,
+            "status": "crashed", "error": str(e)})
+        print(f"[resilience] crashed ({e}); restart "
+              f"{attempt + 1}/{self.max_restarts}")
+        return True
 
     def run(self, leg):
         """Run ``leg(ctx)`` under the restart policy and return its
@@ -355,16 +449,9 @@ class Supervisor:
                                 "lineage": {"segments": self.segments}}
                     print(f"[resilience] preempted at step {e.step}; "
                           f"restart {attempt + 1}/{self.max_restarts}")
-                except InjectedCrash as e:
-                    if attempt >= self.max_restarts:
+                except self._restartable as e:
+                    if not self._on_failure(e, ctx, attempt):
                         raise
-                    self.segments.append({
-                        "attempt": attempt, "scope": "", "run_id": None,
-                        "start_step": ctx.start_step,
-                        "end_step": ctx._last_step,
-                        "status": "crashed", "error": str(e)})
-                    print(f"[resilience] crashed ({e}); restart "
-                          f"{attempt + 1}/{self.max_restarts}")
                 finally:
                     ctx.close()   # torn-save guarantee, every exit path
                 # fresh attempt: clear a consumed preemption notice so

@@ -55,7 +55,7 @@ class StepPump:
 
     def __init__(self, *, telem=None, tracker=None, mode: str = "async",
                  sync_every: int = 10, max_in_flight: int = 16,
-                 profiler=None):
+                 profiler=None, watchdog=None):
         if mode not in ("async", "sync"):
             raise ValueError(f"dispatch mode must be async|sync, got {mode!r}")
         if max_in_flight < 1:
@@ -65,6 +65,11 @@ class StepPump:
         self.mode = mode
         self.sync_every = max(int(sync_every), 0)
         self.max_in_flight = int(max_in_flight)
+        # collective watchdog (resilience.elastic.Watchdog): every
+        # blocking sync point below routes through it so a hung
+        # collective becomes a diagnosable StepTimeoutError with the
+        # in-flight step index attached, never a silent deadlock
+        self.watchdog = watchdog
         self.profiler = profiler if profiler is not None \
             else getattr(telem, "profiler", None)
         self._pending: deque = deque()   # (step_idx, device loss, log cb)
@@ -87,6 +92,14 @@ class StepPump:
     def _count(self, reason: str) -> None:
         self.sync_breakdown[reason] = self.sync_breakdown.get(reason, 0) + 1
 
+    def _block(self, arr, step: int | None = None) -> None:
+        """One blocking wait at a sync point, watchdog-guarded."""
+        import jax
+        if self.watchdog is not None:
+            self.watchdog.block(jax.block_until_ready, arr, step=step)
+        else:
+            jax.block_until_ready(arr)
+
     # ---- resolution ------------------------------------------------------
     def _resolve_one(self, idx: int, arr, log) -> float | None:
         try:
@@ -105,8 +118,7 @@ class StepPump:
         telemetry events that were deferred on them."""
         if not self._pending:
             return
-        import jax
-        jax.block_until_ready(self._pending[-1][1])
+        self._block(self._pending[-1][1], step=self._pending[-1][0])
         while self._pending:
             self._resolve_one(*self._pending.popleft())
         if self.telem is not None:
@@ -125,7 +137,6 @@ class StepPump:
         existing host-sync schedule instead of adding barriers."""
         if self._closed:
             raise RuntimeError("emit() after close()")
-        import jax
         i = self._emitted
         self._emitted += 1
         metrics = None
@@ -136,7 +147,7 @@ class StepPump:
                     and self.profiler.pending_transition())
         if self.mode == "sync" or boundary or (
                 self.sync_every and (i + 1) % self.sync_every == 0):
-            jax.block_until_ready(loss)
+            self._block(loss, step=i)
             self._drain()
             lf = self._resolve_one(i, loss, log)
             self._count("per_step" if self.mode == "sync"
@@ -155,7 +166,7 @@ class StepPump:
                                 tracker_metrics=metrics, **extra)
             if len(self._pending) > self.max_in_flight:
                 idx0, arr0, log0 = self._pending.popleft()
-                jax.block_until_ready(arr0)
+                self._block(arr0, step=idx0)
                 self._resolve_one(idx0, arr0, log0)
                 if self.telem is not None:
                     self.telem.flush(up_to=1)

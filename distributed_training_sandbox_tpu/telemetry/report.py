@@ -273,11 +273,21 @@ def _fmt_segment(seg: dict) -> str:
     return f"[{scope}{span} {seg.get('status', '?')}]"
 
 
+def _fmt_transition(tr: dict) -> str:
+    lost = tr.get("lost_ranks") or []
+    at = (f" at step {tr['step']}" if tr.get("step") is not None else "")
+    why = tr.get("trigger", "?")
+    who = f", lost ranks {lost}" if lost else ""
+    return (f"{tr.get('old_world', '?')} → {tr.get('new_world', '?')} "
+            f"({why}{who}{at})")
+
+
 def render_lineage(rows: list[dict]) -> str:
     """Stitched-segment view of every run whose manifest carries restart
     lineage: the prior segments' spans/status chained into this run,
-    plus where it resumed and whether the collective contract re-check
-    passed on restore."""
+    plus where it resumed, whether the collective contract re-check
+    passed on restore, and — for elastic runs — the mesh transitions
+    (old/new world size, trigger, lost ranks)."""
     out = []
     for r in rows:
         lin = r.get("lineage") or {}
@@ -286,6 +296,8 @@ def render_lineage(rows: list[dict]) -> str:
         segs = [s for s in (lin.get("segments") or [])
                 if isinstance(s, dict)]
         chain = " → ".join(_fmt_segment(s) for s in segs) if segs else ""
+        transitions = [t for t in (lin.get("mesh_transitions") or [])
+                       if isinstance(t, dict)]
         scopes = [("", lin)] + sorted((lin.get("scopes") or {}).items())
         resumed = []
         for label, sc in scopes:
@@ -305,6 +317,9 @@ def render_lineage(rows: list[dict]) -> str:
             line += ": " + "; ".join(resumed)
         if chain:
             line += f"\n  - segments: {chain} → this run"
+        if transitions:
+            line += ("\n  - mesh transitions (elastic): "
+                     + "; ".join(_fmt_transition(t) for t in transitions))
         out.append(line)
     return "\n".join(out) if out else "_no runs with restart lineage_"
 

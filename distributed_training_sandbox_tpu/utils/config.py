@@ -91,6 +91,20 @@ class TrainConfig:
     resume: bool = False         # restore the latest step before the loop
     max_restarts: int = 0        # in-process restart budget after a fault
     inject_fault: str | None = None  # debug: "crash@N" / "preempt@N[:leg]"
+    # --- elastic mesh runtime (resilience/elastic.py) ---------------------
+    # elastic: worker loss (kill_worker fault / heartbeat death / hung
+    # step) shrinks the mesh to the survivors and resumes from the
+    # latest checkpoint instead of being fatal; world_size builds the
+    # mesh over the first N devices (0 = all — the survivor slice after
+    # a shrink, or a deliberate small-mesh run); watchdog_timeout wraps
+    # the pump's sync points so a hung collective raises a diagnosable
+    # StepTimeoutError within the budget; heartbeat_dir is where this
+    # worker's liveness file lands (the launcher coordinator's probe —
+    # defaults to $DTS_HEARTBEAT_DIR when spawned by dts-launch).
+    elastic: bool = False
+    world_size: int = 0
+    watchdog_timeout: float = 0.0
+    heartbeat_dir: str | None = None
 
     @classmethod
     def from_args(cls, argv=None, **overrides) -> "TrainConfig":
@@ -222,6 +236,29 @@ def build_argparser(parser: argparse.ArgumentParser | None = None):
                         "latest checkpoint after a crash/preemption")
     p.add_argument("--inject-fault", dest="inject_fault", type=str,
                    default=None,
-                   help="debug fault injection: crash@N or "
-                        "preempt@N[:leg] (deterministic, fires once)")
+                   help="debug fault injection: crash@N, preempt@N[:leg], "
+                        "kill_worker@N:rank, hang@N, or slow@N:ms "
+                        "(deterministic, fires once)")
+    p.add_argument("--elastic", dest="elastic", action="store_true",
+                   default=None,
+                   help="elastic mesh: on worker loss / hung step, shrink "
+                        "to the survivors (8→4→2), reshard-restore the "
+                        "latest checkpoint, and continue (needs "
+                        "--checkpoint-dir and --max-restarts)")
+    p.add_argument("--world-size", dest="world_size", type=int,
+                   default=None,
+                   help="build the mesh over the first N visible devices "
+                        "(0 = all; the survivor slice of an elastic "
+                        "shrink, or a deliberate small-mesh run)")
+    p.add_argument("--watchdog-timeout", dest="watchdog_timeout",
+                   type=float, default=None,
+                   help="collective watchdog: a pump sync point that "
+                        "does not retire within N seconds raises "
+                        "StepTimeoutError (step index + last contract "
+                        "verdict attached) instead of hanging (0 = off)")
+    p.add_argument("--heartbeat-dir", dest="heartbeat_dir", type=str,
+                   default=None,
+                   help="write this worker's per-step liveness file "
+                        "here (the launcher coordinator's failure "
+                        "detector; default $DTS_HEARTBEAT_DIR)")
     return p

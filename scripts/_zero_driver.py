@@ -61,7 +61,9 @@ def _time_steps(step_fn, state, batch, n_steps, telem=None, label="",
     pump = StepPump(telem=telem,
                     mode=cfg.dispatch if cfg else "async",
                     sync_every=cfg.sync_every if cfg else 10,
-                    max_in_flight=cfg.max_in_flight if cfg else 16)
+                    max_in_flight=cfg.max_in_flight if cfg else 16,
+                    watchdog=ctx.make_watchdog() if ctx is not None
+                    else None)
     with pump:
         for i in range(start, total):
             if ctx is not None and ctx.should_stop(i):
@@ -129,7 +131,10 @@ def _zero_ab_leg(stage, args, cfg, root_ctx):
     from distributed_training_sandbox_tpu.ops import count_collectives
     from distributed_training_sandbox_tpu.resilience import RunState
 
-    mesh = make_mesh()
+    # elastic: rebuild from this attempt's survivor slice (a shrink
+    # re-runs both legs at the smaller world; completed legs replay
+    # nothing, interrupted ones reshard-restore)
+    mesh = make_mesh(devices=root_ctx.mesh_devices())
     ws = get("ws")
     name = f"zero{stage}"
     print(f"[{name}] mesh={dict(mesh.shape)} ws={ws} "

@@ -90,7 +90,10 @@ def _mlp_leg(args, cfg, ctx):
     from distributed_training_sandbox_tpu.ops import smap, count_collectives
     from jax.sharding import PartitionSpec as P
 
-    mesh = make_mesh()
+    # elastic: the mesh is built from this attempt's survivor slice —
+    # after a shrink the same leg re-runs at the smaller world size and
+    # the restore below reshards into it
+    mesh = make_mesh(devices=ctx.mesh_devices())
     ws = get("ws")
     print(f"[ddp] mesh={dict(mesh.shape)} devices={ws} "
           f"platform={jax.devices()[0].platform}")
@@ -184,7 +187,8 @@ def _mlp_leg(args, cfg, ctx):
                             profiler=prof) as telem:
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
-                      max_in_flight=cfg.max_in_flight) as pump:
+                      max_in_flight=cfg.max_in_flight,
+                      watchdog=ctx.make_watchdog()) as pump:
             for i, batch in zip(range(ctx.start_step, cfg.num_steps), pref):
                 if ctx.should_stop(i):
                     break
@@ -251,7 +255,7 @@ def _classification_leg(args, cfg, ctx):
     import functools
 
     mcfg: T.TransformerConfig = getattr(T, MODEL_REGISTRY[args.model])
-    mesh = make_mesh()
+    mesh = make_mesh(devices=ctx.mesh_devices())
     ws = get("ws")
     if cfg.batch_size % ws:
         raise SystemExit(f"--batch-size {cfg.batch_size} must be divisible "
@@ -334,7 +338,8 @@ def _classification_leg(args, cfg, ctx):
                             profiler=prof) as telem:
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
-                      max_in_flight=cfg.max_in_flight) as pump:
+                      max_in_flight=cfg.max_in_flight,
+                      watchdog=ctx.make_watchdog()) as pump:
             for i, jbatch in zip(range(ctx.start_step, cfg.num_steps), pref):
                 if ctx.should_stop(i):
                     break

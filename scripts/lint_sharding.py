@@ -131,6 +131,14 @@ def main(argv=None) -> int:
     if not args.skip_scripts:
         from distributed_training_sandbox_tpu.analysis import lint_tree
         findings = lint_tree(args.scripts_dir)
+        # the package tree gets the swallowed-distributed-error check
+        # too: a silent `except Exception: pass` around a collective in
+        # library code is exactly as hang-prone as one in a script
+        pkg_dir = Path(args.scripts_dir).resolve().parent \
+            / "distributed_training_sandbox_tpu"
+        if pkg_dir.is_dir():
+            findings += lint_tree(pkg_dir, recursive=True,
+                                  checks={"swallowed-distributed-error"})
         report["pitfalls"] = [f.to_dict() for f in findings]
         errors = [f for f in findings if f.severity == "error"]
         for f in findings:
@@ -140,7 +148,7 @@ def main(argv=None) -> int:
             report["ok"] = False
         print(f"[lint] pitfalls: {len(errors)} error(s), "
               f"{len(findings) - len(errors)} warning(s) over "
-              f"{args.scripts_dir}")
+              f"{args.scripts_dir} + {pkg_dir.name}")
 
     if args.strict:
         for sub in report["strategies"].values():

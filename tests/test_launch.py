@@ -199,6 +199,127 @@ def test_launcher_multiprocess_zero1(tmp_path):
     assert "A/B report" in w1
 
 
+def test_worker_group_propagates_first_nonzero_exit_code(tmp_path):
+    """Satellite (ISSUE 7): the launch must exit with the FIRST failing
+    worker's code — 3 stays 3, a SIGKILLed worker reports 128+9 — not a
+    flattened 1, and the survivors must be torn down promptly."""
+    import os
+
+    from distributed_training_sandbox_tpu.launch.launcher import (
+        LaunchConfig, _run_worker_group)
+
+    cfg = LaunchConfig(device_spec="cpu:1", trace_root=tmp_path,
+                       timeout=120)
+    cmd = [sys.executable, "-c",
+           "import os,sys,time; "
+           "sys.exit(3) if os.environ['DTS_PROCESS_ID']=='1' "
+           "else time.sleep(300)"]
+    res = _run_worker_group(cfg, cmd, dict(os.environ), tmp_path, 2)
+    assert res.returncode == 3
+    assert res.failed_ranks == [1]
+    assert res.detect_s is not None and res.detect_s < 60
+
+    cmd = [sys.executable, "-c",
+           "import os,signal,sys,time; "
+           "os.kill(os.getpid(), signal.SIGKILL) "
+           "if os.environ['DTS_PROCESS_ID']=='0' else time.sleep(300)"]
+    res = _run_worker_group(cfg, cmd, dict(os.environ), tmp_path, 2)
+    assert res.returncode == 128 + 9
+    assert res.failed_ranks == [0]
+
+
+def test_workers_die_with_coordinator(tmp_path):
+    """Satellite (ISSUE 7): when the coordinator process itself is
+    SIGKILLed, the spawned workers must not outlive it (PDEATHSIG) —
+    today's stragglers-outlive-the-launch hole."""
+    import os
+    import signal
+    import time
+
+    coordinator = (
+        "import os, sys; from pathlib import Path\n"
+        "sys.path.insert(0, sys.argv[2])\n"
+        "from distributed_training_sandbox_tpu.launch.launcher import ("
+        "LaunchConfig, _run_worker_group)\n"
+        "cfg = LaunchConfig(device_spec='cpu:1', timeout=120)\n"
+        "cmd = [sys.executable, '-c', "
+        "\"import os,sys,time;"
+        "open(sys.argv[1]+'/pid_'+os.environ['DTS_PROCESS_ID'],'w')"
+        ".write(str(os.getpid()));time.sleep(300)\", sys.argv[1]]\n"
+        "_run_worker_group(cfg, cmd, dict(os.environ), "
+        "Path(sys.argv[1]), 2)\n")
+    coord = subprocess.Popen(
+        [sys.executable, "-c", coordinator, str(tmp_path),
+         str(Path(__file__).parent.parent)])
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and len(
+                list(Path(tmp_path).glob("pid_*"))) < 2:
+            time.sleep(0.1)
+        pids = [int(p.read_text())
+                for p in Path(tmp_path).glob("pid_*")]
+        assert len(pids) == 2, "workers never started"
+        coord.kill()                      # the coordinator dies hard
+        coord.wait()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            alive = []
+            for pid in pids:
+                try:
+                    os.kill(pid, 0)
+                    alive.append(pid)
+                except ProcessLookupError:
+                    pass
+            if not alive:
+                break
+            time.sleep(0.2)
+        assert not alive, f"workers {alive} outlived the coordinator"
+    finally:
+        if coord.poll() is None:
+            coord.kill()
+            coord.wait()
+
+
+def test_elastic_group_shrinks_and_relaunches_with_resume(tmp_path):
+    """The launcher-coordinator elastic loop: worker 1 SIGKILLs itself
+    (with a heartbeat breadcrumb), the group is torn down, and the
+    relaunch runs 4 → 2 workers with --resume appended — rc 0."""
+    import os
+
+    from distributed_training_sandbox_tpu.launch.launcher import (
+        LaunchConfig, run_elastic_group)
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import json, os, signal, sys, time\n"
+        "rank = int(os.environ['DTS_PROCESS_ID'])\n"
+        "n = int(os.environ['DTS_NUM_PROCESSES'])\n"
+        "hb = os.environ.get('DTS_HEARTBEAT_DIR')\n"
+        "state = sys.argv[1]\n"
+        "if '--resume' in sys.argv:\n"
+        "    with open(f'{state}/resumed_{n}_{rank}', 'w') as f:\n"
+        "        json.dump({'hb': hb}, f)\n"
+        "    sys.exit(0)\n"
+        "if rank == 1:\n"
+        "    if hb:\n"
+        "        with open(f'{hb}/worker_1.dead', 'w') as f:\n"
+        "            f.write('{}')\n"
+        "    os.kill(os.getpid(), signal.SIGKILL)\n"
+        "time.sleep(300)\n")
+    cfg = LaunchConfig(device_spec="cpu:1", trace_root=tmp_path,
+                       timeout=120, elastic=True, group_restarts=1,
+                       heartbeat_timeout=5.0)
+    rc = run_elastic_group(
+        cfg, [sys.executable, str(worker), str(tmp_path)],
+        dict(os.environ), tmp_path, 4)
+    assert rc == 0
+    resumed = sorted(p.name for p in Path(tmp_path).glob("resumed_*"))
+    assert resumed == ["resumed_2_0", "resumed_2_1"]
+    # the relaunched workers saw the heartbeat env contract
+    assert json.loads(
+        (tmp_path / "resumed_2_0").read_text())["hb"] is not None
+
+
 def test_multiprocess_early_abort_on_worker_failure(tmp_path):
     """r4 advisor: if one worker dies during bring-up, the group must be
     killed promptly instead of the survivors blocking in collectives

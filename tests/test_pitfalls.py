@@ -275,3 +275,93 @@ def test_gather_ok_pragma_suppresses():
         "    return shard_map(step)\n")
     assert not [x for x in lint_source(src, "s.py")
                 if x.check == "gather-in-step"]
+
+
+# ---- swallowed-distributed-error (ISSUE 7 satellite) ---------------------
+
+def test_swallowed_collective_error_is_flagged():
+    src = (
+        "from jax import lax\n"
+        "def loop(xs):\n"
+        "    for x in xs:\n"
+        "        try:\n"
+        "            lax.psum(x, 'dp')\n"
+        "        except Exception:\n"
+        "            pass\n"
+        "f = shard_map(loop)\n")
+    hits = [x for x in lint_source(src, "s.py")
+            if x.check == "swallowed-distributed-error"]
+    assert len(hits) == 1 and hits[0].severity == SEV_ERROR
+    assert "silent hang" in hits[0].message
+
+
+def test_swallowed_step_error_via_continue_is_flagged():
+    src = (
+        "def run(train_step, batches):\n"
+        "    for b in batches:\n"
+        "        try:\n"
+        "            out = train_step(b)\n"
+        "        except Exception:\n"
+        "            continue\n")
+    hits = [x for x in lint_source(src, "s.py")
+            if x.check == "swallowed-distributed-error"]
+    assert len(hits) == 1
+
+
+def test_bare_except_around_collective_is_flagged():
+    src = (
+        "from jax import lax\n"
+        "def loop(x):\n"
+        "    try:\n"
+        "        lax.all_gather(x, 'dp')\n"
+        "    except:\n"
+        "        pass\n"
+        "f = shard_map(loop)\n")
+    assert [x for x in lint_source(src, "s.py")
+            if x.check == "swallowed-distributed-error"]
+
+
+def test_handled_or_nondistributed_swallows_are_fine():
+    src = (
+        "from jax import lax\n"
+        "def loop(x):\n"
+        "    try:\n"
+        "        lax.psum(x, 'dp')\n"
+        "    except Exception as e:\n"
+        "        print(e)\n"                   # handles: fine
+        "    try:\n"
+        "        helper(x)\n"                  # not distributed: fine
+        "    except Exception:\n"
+        "        pass\n"
+        "    try:\n"
+        "        lax.psum(x, 'dp')\n"
+        "    except ValueError:\n"             # narrow catch: fine
+        "        pass\n"
+        "f = shard_map(loop)\n")
+    assert not [x for x in lint_source(src, "s.py")
+                if x.check == "swallowed-distributed-error"]
+
+
+def test_swallow_ok_pragma_suppresses():
+    src = (
+        "from jax import lax\n"
+        "def loop(x):\n"
+        "    try:\n"
+        "        lax.psum(x, 'dp')\n"
+        "    except Exception:  # swallow-ok: probe path\n"
+        "        pass\n"
+        "f = shard_map(loop)\n")
+    assert not [x for x in lint_source(src, "s.py")
+                if x.check == "swallowed-distributed-error"]
+
+
+def test_package_tree_clean_of_swallowed_distributed_errors():
+    """The satellite's CI property: scripts/ AND the package tree carry
+    no except-and-discard around collective/step calls."""
+    pkg = Path(__file__).resolve().parent.parent \
+        / "distributed_training_sandbox_tpu"
+    findings = lint_tree(pkg, recursive=True,
+                         checks={"swallowed-distributed-error"})
+    assert not findings, [f.to_dict() for f in findings]
+    assert not [f for f in lint_tree(SCRIPTS_DIR)
+                if f.check == "swallowed-distributed-error"]

@@ -94,6 +94,97 @@ def test_zero3_opt_state_reshards_into_different_mesh(mesh8, tmp_path):
         l.sharding.mesh.shape == mesh4.shape for l in sharded_leaves)
 
 
+def _zeros_like_on(tree, mesh):
+    """``like`` twin of ``tree`` with every sharded leaf re-placed on
+    ``mesh`` (same PartitionSpec), scalars left untouched."""
+    return jax.tree.map(
+        lambda a: jax.device_put(
+            jnp.zeros(a.shape, a.dtype),
+            NamedSharding(mesh, a.sharding.spec))
+        if getattr(a, "ndim", 0) else a, tree)
+
+
+@pytest.mark.parametrize("save_ws,restore_ws", [(8, 4), (4, 8)])
+def test_zero2_opt_state_reshards_shrink_and_grow(mesh8, tmp_path,
+                                                  save_ws, restore_ws):
+    """The world-size-change gap (ISSUE 7 satellite): zero1/2's chunked
+    AdamState saved on one world size restores — resharded — into BOTH
+    a smaller and a LARGER mesh (the grow path was untested).  Param
+    sizes divisible by both worlds so the padded chunk layout matches."""
+    from distributed_training_sandbox_tpu.models import init_mlp
+    from distributed_training_sandbox_tpu.parallel.zero import (
+        init_zero_opt_state)
+    from distributed_training_sandbox_tpu.utils import set_seed
+
+    meshes = {8: mesh8,
+              4: Mesh(np.array(jax.devices()[:4]).reshape(4), ("dp",))}
+    params = init_mlp(set_seed(0), (48, 48, 48))
+    opt = init_zero_opt_state(params, meshes[save_ws], "dp")
+    ck = RZ.Checkpointer(tmp_path / f"z2-{save_ws}")
+    ck.save(RZ.RunState(params=params, opt_state=opt, step=1,
+                        data_cursor=2, loss_log=[1.0, 0.5]), wait=True)
+
+    like_opt = init_zero_opt_state(params, meshes[restore_ws], "dp")
+    rs = RZ.restore_run_state(ck.mgr, like=RZ.RunState(
+        params=params, opt_state=like_opt))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), rs.opt_state, opt)
+    sharded_leaves = [l for l in jax.tree.leaves(rs.opt_state)
+                     if getattr(l, "ndim", 0)]
+    assert sharded_leaves and all(
+        l.sharding.mesh.shape == meshes[restore_ws].shape
+        for l in sharded_leaves)
+
+
+def test_zero3_chunked_params_reshard_grow_4to8(mesh8, tmp_path):
+    """Grow path for zero3's chunked params + opt: saved on a 4-way
+    mesh, restored into the 8-way one — the elastic runtime's recovery
+    direction when capacity returns."""
+    from distributed_training_sandbox_tpu.models import init_mlp
+    from distributed_training_sandbox_tpu.parallel.zero import (
+        init_zero_opt_state, shard_params_zero3)
+    from distributed_training_sandbox_tpu.utils import set_seed
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]).reshape(4), ("dp",))
+    params = init_mlp(set_seed(0), (48, 48, 48))
+    chunks4 = shard_params_zero3(params, mesh4, "dp")
+    opt4 = init_zero_opt_state(params, mesh4, "dp")
+    ck = RZ.Checkpointer(tmp_path / "z3grow")
+    ck.save(RZ.RunState(params=chunks4, opt_state=opt4, step=2,
+                        data_cursor=3, loss_log=[1.0, 0.5, 0.25]),
+            wait=True)
+
+    like = RZ.RunState(params=shard_params_zero3(params, mesh8, "dp"),
+                       opt_state=init_zero_opt_state(params, mesh8, "dp"))
+    rs = RZ.restore_run_state(ck.mgr, like=like)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), rs.params, chunks4)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), rs.opt_state, opt4)
+    for leaf in jax.tree.leaves(rs.params):
+        assert leaf.sharding.mesh.shape == mesh8.shape
+
+
+def test_restore_re_uncommits_uncommitted_leaves(mesh8, tmp_path):
+    """The re-uncommit contract in state.py, pinned for the world-size-
+    change path: a leaf that was UNCOMMITTED in ``like`` (Adam's host
+    count scalar) comes back uncommitted — a scalar pinned to device 0
+    next to mesh-sharded params is an incompatible-devices jit error on
+    the very next step."""
+    x = _sharded(mesh8, np.arange(16.0))
+    opt = {"mu": x * 2, "count": jnp.zeros((), jnp.int32)}
+    ck = RZ.Checkpointer(tmp_path / "uncommit")
+    ck.save(RZ.RunState(params={"w": x}, opt_state=opt, step=0,
+                        data_cursor=1), wait=True)
+    mesh4 = Mesh(np.array(jax.devices()[:4]).reshape(4), ("dp",))
+    like = RZ.RunState(params=_zeros_like_on({"w": x}, mesh4),
+                       opt_state={"mu": _zeros_like_on(x, mesh4),
+                                  "count": jnp.zeros((), jnp.int32)})
+    rs = RZ.restore_run_state(ck.mgr, like=like)
+    assert getattr(rs.opt_state["count"], "_committed", True) is False
+    assert rs.params["w"].sharding.mesh.shape == mesh4.shape
+
+
 def test_corrupted_checkpoint_restore_fails_readably(mesh8, tmp_path):
     x = _sharded(mesh8, np.arange(8.0))
     ck = RZ.Checkpointer(tmp_path / "bad")
