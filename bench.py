@@ -454,6 +454,51 @@ def measure_elastic_resume(model_name: str, seq: int, batch: int) -> dict:
     }
 
 
+def measure_serving(model_name: str, n_requests: int = 24) -> dict:
+    """The serving runtime's cost row: a closed burst (every request
+    present at t=0) through the continuous-batching engine, so the
+    numbers isolate the engine itself — admit/evict bookkeeping per
+    decode step, steady-state slot occupancy, pool pressure — rather
+    than arrival statistics (scripts/serve_bench.py owns the open-loop
+    Poisson SLO story).  Retraces-after-warmup rides along as the
+    static-shape gate."""
+    import jax
+    import numpy as np
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.serving import ServingEngine
+
+    cfg = getattr(T, model_name)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    eng = ServingEngine(params, cfg, max_batch=4, page_size=8,
+                        max_seq_len=64, prefill_chunk=16, sync_every=4)
+    for _ in range(n_requests):
+        plen = int(rng.integers(4, 33))
+        prompt = rng.integers(1, cfg.vocab_size, size=plen).astype("int32")
+        eng.submit(prompt, max_new_tokens=int(rng.integers(4, 17)))
+    t0 = time.perf_counter()
+    eng.run()
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    slo = eng.slo_report()
+    sched = slo["scheduler"]
+    steps = max(sched["decode_steps"], 1)
+    return {
+        "config": "serving", "model": model_name,
+        "requests": slo["completed"],
+        "wall_ms": round(wall_ms, 1),
+        "decode_steps": sched["decode_steps"],
+        "prefill_chunks": sched["prefill_chunks"],
+        "admit_ms_total": sched["admit_ms_total"],
+        "scheduler_overhead_ms_per_step": round(
+            (sched["admit_ms_total"] + sched["bookkeep_ms_total"]) / steps,
+            3),
+        "mean_occupancy": sched["mean_occupancy"],
+        "pool_peak_util": slo["pool"]["peak_util"],
+        "tokens_per_s": slo["tokens_per_s"],
+        "retraces_after_warmup": slo["recompiles_after_warmup"],
+    }
+
+
 def measure_planner_fit(model_name: str, seq: int, batch: int,
                         budget_gb: float) -> dict:
     """The memory planner's payoff row: a batch the raw matrix cannot run
@@ -547,6 +592,14 @@ def main():
                        "error": f"{type(e).__name__}: {str(e)[:120]}"}
     print(f"[bench] elastic_resume {elastic_row}", file=sys.stderr,
           flush=True)
+    try:
+        # always the tiny tier: the serving row measures engine overhead
+        # (admit/evict cost, occupancy), not model throughput
+        serving_row = measure_serving("TINY_LM")
+    except Exception as e:  # noqa: BLE001 - the bench line must print
+        serving_row = {"config": "serving",
+                       "error": f"{type(e).__name__}: {str(e)[:120]}"}
+    print(f"[bench] serving {serving_row}", file=sys.stderr, flush=True)
     # planner payoff row: the OOM-wall batch (8× base — every matrix
     # crossing at that scale dies on HBM) auto-fitted under the device's
     # own capacity.  Only meaningful where the backend reports one.
@@ -599,6 +652,7 @@ def main():
         "overlap_ab": overlap_ab,
         "checkpoint_overhead": ckpt_row,
         "elastic_resume": elastic_row,
+        "serving": serving_row,
         "planner_fit": plan_row,
         "matrix": matrix,
     }

@@ -324,6 +324,19 @@ CONTRACTS: dict[str, CollectiveContract] = {
         payload_bytes=None,
         description="4 all_to_all sites (dispatch/return × fwd/bwd) + "
                     "per-leaf grad psums; gathers/scatters forbidden"),
+    # serving decode (serving.engine.make_serve_decode_step under tp):
+    # inference-only, so the whole choreography is the layer body's two
+    # rejoin psums — and the layer stack is UNROLLED (static layer index
+    # into the per-layer KV pools), so the sites scale with depth instead
+    # of collapsing like the scanned train steps.  Params stay sharded at
+    # rest: any gather/scatter site means a weight went replicated, and
+    # any dp-axis collective means requests leaked across slots.
+    "serve_decode": CollectiveContract(
+        "serve_decode", ("tp",),
+        lambda c: {"all_reduce": 2 * c.n_layers},
+        payload_bytes=None,
+        description="2 activation psums per (unrolled) layer over tp "
+                    "only; no grads, so no other collective may appear"),
     # pipeline stages are single-device jitted programs; inter-stage comm
     # is host-mediated device transfer, never a mesh collective
     "gpipe": CollectiveContract(

@@ -20,7 +20,7 @@ from .contracts import CONTRACTS, ContractContext
 
 STRATEGIES = ("ddp", "ddp_bucketed", "ddp_q8", "zero1", "zero2", "zero3",
               "fsdp", "fsdp_ring", "fsdp_offload", "tp", "tp_ring", "sp",
-              "moe", "gpipe", "1f1b")
+              "moe", "serve_decode", "gpipe", "1f1b")
 
 # the canonical bucket size for the ddp_bucketed fixture — small enough
 # that the toy MLP needs several buckets, so the formula is exercised
@@ -179,6 +179,45 @@ def build_strategy(strategy: str, *, mesh=None, scale: int = 100,
         return StrategyBuild(strategy, step, (shards, opt, probe),
                              _state_advance, mesh, ctx, donate=True,
                              full_param_shapes=shapes)
+
+    # ---- serving decode step over dp × tp ------------------------------
+    if strategy == "serve_decode":
+        from ..models.generate import _decode_cfg
+        from ..serving import PagedKVPool, make_serve_decode_step
+        mcfg = T.TINY_LM
+        if mesh is None:
+            if n_dev < 4:
+                raise RuntimeError(
+                    f"serve_decode fixture needs >= 4 devices "
+                    f"(have {n_dev})")
+            mesh = make_mesh({"dp": n_dev // 2, "tp": 2}, register=False)
+        params = T.init_params(key, mcfg)
+        shapes = param_shapes(params, min_numel=1024)
+        ctx = ContractContext.capture(params=params, mesh=mesh,
+                                      n_layers=mcfg.num_hidden_layers)
+        shards = tensor.shard_params_tp(params, mesh)
+        page_size, pages_per = 8, 4
+        pool = PagedKVPool(_decode_cfg(mcfg),
+                           batch_size * pages_per + 1, page_size,
+                           mesh=mesh)
+        step = make_serve_decode_step(mcfg, shards, mesh=mesh,
+                                      pool_spec=pool.spec)
+        import numpy as np
+        pages = jnp.asarray(np.arange(
+            1, batch_size * pages_per + 1,
+            dtype=np.int32).reshape(batch_size, pages_per))
+        args = (pool.bufs, shards, pages,
+                jnp.zeros((batch_size,), jnp.int32),       # tokens
+                jnp.zeros((batch_size,), jnp.int32),       # lengths
+                jnp.full((batch_size,), page_size * pages_per - 1,
+                         jnp.int32),                       # stop_at
+                jnp.ones((batch_size,), bool))             # active
+        # outputs: (nxt, new_len, new_active, bufs, occ) — feed the
+        # donated pool and the token/length/active chain back in
+        advance = lambda args, out: (out[3], args[1], args[2], out[0],
+                                     out[1], args[5], out[2])
+        return StrategyBuild(strategy, step, args, advance, mesh, ctx,
+                             donate=True, full_param_shapes=shapes)
 
     # ---- pipeline schedules: single-device stage programs --------------
     from ..parallel.pipeline import build_pipeline

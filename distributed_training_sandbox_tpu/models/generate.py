@@ -294,9 +294,21 @@ def _forward_cached(params, ids, cfg, cache: KVCache, start,
 
 def _generate_core(params, prompt_ids, rng, cfg: T.TransformerConfig,
                    max_new_tokens: int, temperature: float,
-                   tp_axis=None, kv_quant: bool = False):
+                   tp_axis=None, kv_quant: bool = False,
+                   cache_capacity: int | None = None):
     B, S0 = prompt_ids.shape
-    S_max = S0 + max_new_tokens
+    # ``cache_capacity`` pins the attention's contraction extent: XLA's
+    # softmax-denominator reduction order depends on the K dimension, so
+    # two decodes agree BITWISE only when they contract over the same
+    # capacity (masked tail positions contribute exact zeros, but the
+    # sum's association differs).  The serving engine always contracts
+    # over its fixed page-pool view; parity checks pass the same value
+    # here.
+    if cache_capacity is not None and cache_capacity < S0 + max_new_tokens:
+        raise ValueError(
+            f"cache_capacity={cache_capacity} < prompt+new "
+            f"({S0}+{max_new_tokens}); the decode would write past it")
+    S_max = cache_capacity or (S0 + max_new_tokens)
     tp = axis_size(tp_axis) if tp_axis else 1
     cache = init_cache(cfg, B, S_max, tp=tp, quantized=kv_quant)
     logits, cache = _forward_cached(params, prompt_ids, cfg, cache, 0,
@@ -329,19 +341,25 @@ def _generate_core(params, prompt_ids, rng, cfg: T.TransformerConfig,
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
-                                   "temperature", "kv_quant"))
+                                   "temperature", "kv_quant",
+                                   "cache_capacity"))
 def generate(params, prompt_ids, cfg: T.TransformerConfig, *,
              max_new_tokens: int = 32, temperature: float = 0.0,
-             rng: jax.Array | None = None, kv_quant: bool = False):
+             rng: jax.Array | None = None, kv_quant: bool = False,
+             cache_capacity: int | None = None):
     """Decode ``max_new_tokens`` after ``prompt_ids`` (B, S_prompt).
 
     temperature 0 = greedy argmax; > 0 = categorical sampling — ``rng``
     is then REQUIRED (a silent default key would return identical
     "samples" on every call).  ``kv_quant`` stores the KV cache int8
     with per-row scales — half the cache-read bytes per step, the
-    long-prompt lever.  Returns (B, max_new_tokens) int32.  One prefill
-    forward + one scanned decode loop — two compiled programs total,
-    static shapes throughout.
+    long-prompt lever.  ``cache_capacity`` (static) pads the cache to a
+    fixed S_max ≥ prompt+new — the attention then contracts over that
+    capacity, which is what makes tokens bitwise-comparable against the
+    serving engine's fixed-size paged view (see ``serving.engine``).
+    Returns (B, max_new_tokens) int32.  One prefill forward + one
+    scanned decode loop — two compiled programs total, static shapes
+    throughout.
     """
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 samples stochastically: pass "
@@ -350,7 +368,8 @@ def generate(params, prompt_ids, cfg: T.TransformerConfig, *,
         rng = jax.random.PRNGKey(0)   # unused by greedy picks
     return _generate_core(params, prompt_ids, rng, _decode_cfg(cfg),
                           max_new_tokens, temperature,
-                          kv_quant=kv_quant)
+                          kv_quant=kv_quant,
+                          cache_capacity=cache_capacity)
 
 
 def _decode_cfg(cfg: T.TransformerConfig) -> T.TransformerConfig:
@@ -366,7 +385,8 @@ def _decode_cfg(cfg: T.TransformerConfig) -> T.TransformerConfig:
 
 def make_tp_generate(cfg: T.TransformerConfig, mesh, *, axis: str = "tp",
                      max_new_tokens: int = 32, temperature: float = 0.0,
-                     kv_quant: bool = False):
+                     kv_quant: bool = False,
+                     cache_capacity: int | None = None):
     """TP-sharded decode: ``fn(params_tp, prompt_ids, rng) -> tokens``.
 
     ``params_tp`` hold Megatron layer shards
@@ -385,7 +405,8 @@ def make_tp_generate(cfg: T.TransformerConfig, mesh, *, axis: str = "tp",
     def core(params, prompt_ids, rng):
         return _generate_core(params, prompt_ids, rng, cfg,
                               max_new_tokens, temperature, tp_axis=axis,
-                              kv_quant=kv_quant)
+                              kv_quant=kv_quant,
+                              cache_capacity=cache_capacity)
 
     compiled = {}   # built once on first call (specs need a params tree)
 

@@ -1,0 +1,28 @@
+"""Serving runtime: continuous-batching inference over a sharded paged
+KV cache, with latency-SLO telemetry.
+
+The inference counterpart of the training ``runtime``: ``engine`` drives
+fixed-shape jitted decode steps over ``kv_pool``'s page blocks under
+``scheduler``'s WAITING→PREFILL→DECODE→DONE state machine, and
+``accounting`` holds the byte formulas shared with the decode roofline
+bench plus the pool capacity planner.  Entry points:
+:class:`ServingEngine` / :func:`serve` here, ``scripts/serve_bench.py``
+for the Poisson-traffic SLO report.
+"""
+
+from .accounting import (kv_bytes_per_step, page_bytes,
+                         pool_capacity_pages, serve_waterline_gb,
+                         weight_read_bytes)
+from .engine import (ServingEngine, make_serve_decode_step,
+                     make_serve_prefill_step, serve)
+from .kv_pool import PageAllocator, PagedKVPool, PoolBuffers
+from .scheduler import ContinuousBatcher, Request
+
+__all__ = [
+    "ServingEngine", "serve", "make_serve_decode_step",
+    "make_serve_prefill_step",
+    "PagedKVPool", "PageAllocator", "PoolBuffers",
+    "ContinuousBatcher", "Request",
+    "kv_bytes_per_step", "weight_read_bytes", "page_bytes",
+    "serve_waterline_gb", "pool_capacity_pages",
+]

@@ -1,0 +1,764 @@
+"""Continuous-batching decode engine over the paged KV pool.
+
+The serving half of ``models/generate.py``: same layer math, different
+cache substrate and driver.  Three invariants carry the design:
+
+**Bitwise parity with one-shot decode.**  Every per-row op (rms_norm,
+projections, per-query-row attention, logits) is bitwise-independent of
+which OTHER rows share its batch — so chunked prefill, mixed-length
+ragged batches, and admit/evict churn cannot change a request's tokens
+… with ONE exception, measured on this backend: the softmax
+denominator's reduction order depends on the attention's contraction
+extent.  The engine therefore always contracts over the FIXED pool view
+(``P_max × page_size`` positions; masked tails contribute exact zeros),
+and one-shot ``generate`` grew a static ``cache_capacity`` arg to pin
+the same extent.  With matched capacity, serving output is
+bitwise-identical to ``generate`` — the invariant the parity suite
+asserts per request.
+
+**Zero retraces after warmup.**  The decode step has static shape:
+``max_batch`` slots, an active mask, full-size page-table rows.
+Admit/evict between bursts rewrites host arrays and ``device_put``s the
+same shapes/dtypes/shardings — the jit cache stays at one entry per
+program over a whole traffic trace (``slo_report`` carries the watch).
+
+**Host blocks only at sync points.**  Decode bursts chain
+``sync_every`` donated-buffer steps through ``runtime.StepPump``'s
+bounded in-flight dispatch; the host resolves tokens, retires finished
+requests and admits new ones once per burst.  Prefill is synchronous at
+admission (TTFT is measured at first-token resolution) and CHUNKED so a
+long prompt shares rounds with decode instead of stalling it.
+
+Modes: single-program (default, one jit per device set), tensor-parallel
+(``mesh`` + ``tp_axis``: params via ``parallel.tensor.tp_specs``, pool
+heads sharded, 2 psums/layer — the ``serve_decode`` contract), and
+prefill/decode DISAGGREGATED (mesh split into a prefill slice and a
+decode slice as separate single-device programs, KV handed off by page
+block — the separate-programs-per-role seam; intra-slice sharding is
+future work).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import transformer as T
+from ..models.generate import _decode_cfg, _quant_kv
+from ..ops import collectives as C
+from .kv_pool import PagedKVPool, PoolBuffers
+from .scheduler import ContinuousBatcher, DECODE, PREFILL, Request
+
+__all__ = ["ServingEngine", "serve", "make_serve_decode_step",
+           "make_serve_prefill_step"]
+
+
+# ---------------------------------------------------------------- layer math
+
+def _ragged_rope_tables(positions, head_dim: int, theta: float):
+    """Per-BATCH rope tables: ``positions`` (B, S) int32 → cos/sin
+    (B, S, hd/2) f32.  Same inv_freq/angle formula as
+    ``transformer._rope_tables`` so a position's table row is bitwise
+    the one the one-shot path computes for it."""
+    inv_freq = 1.0 / theta ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim)
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _apply_rope_ragged(x, cos, sin):
+    """``transformer.apply_rope`` with per-batch tables: x (B, S, n, hd),
+    cos/sin (B, S, hd/2) — identical split-half rotation, broadcast over
+    heads instead of batch."""
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(dt)
+
+
+def _paged_layer_body(x, layer, *, cfg, cos, sin, use_rope, pk, pv,
+                      pk_s, pv_s, pages, apos, valid, tp_axis=None):
+    """One decoder layer against the PAGED pool — the numerics of
+    ``generate._cached_layer_body`` with scatter/gather storage:
+
+      * new K/V rows scatter token-granularly into their page table
+        slots; rows with ``valid`` False (prompt padding, inactive
+        decode slots) divert to the reserved null page 0;
+      * attention gathers the slot's pages back into a contiguous
+        (B, n_kv, V, hd) view — position ``v`` of the view IS absolute
+        position ``v`` (pages are ordered), so the causal mask
+        ``pos_kv <= apos`` is unchanged and masked stale/garbage
+        positions contribute exact zeros (finite garbage → −1e30 score
+        → 0.0 prob), which is what keeps the paged path bitwise equal
+        to the contiguous cache at matched contraction extent.
+
+    x (B, S, H); pages (B, P) int32; apos (B, S) int32 absolute
+    positions of x's rows; valid (B, S) bool."""
+    B, S, H = x.shape
+    hd = cfg.resolved_head_dim
+    tp = C.axis_size(tp_axis) if tp_axis else 1
+    nq = cfg.num_attention_heads // tp
+    nkv = cfg.num_key_value_heads // tp
+    dense = T._dense(cfg)
+    page = pk.shape[1]
+    P = pages.shape[1]
+    V = P * page
+
+    r = T.rms_norm(x, layer["ln1"], cfg.rms_norm_eps)
+    q = dense(r, layer["wq"]).reshape(B, S, nq, hd)
+    k = dense(r, layer["wk"]).reshape(B, S, nkv, hd)
+    v = dense(r, layer["wv"]).reshape(B, S, nkv, hd)
+    q = jnp.where(use_rope, _apply_rope_ragged(q, cos, sin), q)
+    k = jnp.where(use_rope, _apply_rope_ragged(k, cos, sin), k)
+
+    # scatter the new rows: target page from the slot's table, offset
+    # within it; invalid rows all collapse onto page 0 (duplicate
+    # scatter targets there are fine — it's the trash page)
+    pi = jnp.clip(apos // page, 0, P - 1)
+    pg = jnp.where(valid, jnp.take_along_axis(pages, pi, axis=1), 0)
+    off = apos % page
+    quantized = pk.dtype == jnp.int8
+    if quantized:
+        kq, ks_new = _quant_kv(k)
+        vq, vs_new = _quant_kv(v)
+        pk = pk.at[pg, off].set(kq)
+        pv = pv.at[pg, off].set(vq)
+        pk_s = pk_s.at[pg, off].set(ks_new)
+        pv_s = pv_s.at[pg, off].set(vs_new)
+    else:
+        pk = pk.at[pg, off].set(k)
+        pv = pv.at[pg, off].set(v)
+
+    # gather the slot's pages into the contiguous head-major view the
+    # attention contracts over — fixed extent V for every request, the
+    # parity-bearing choice (see module docstring)
+    vk = pk[pages].reshape(B, V, nkv, hd).transpose(0, 2, 1, 3)
+    vv = pv[pages].reshape(B, V, nkv, hd).transpose(0, 2, 1, 3)
+
+    rep = nq // nkv
+    qg = q.reshape(B, S, nkv, rep, hd)
+    if quantized:
+        vk_s = pk_s[pages].reshape(B, V, nkv, 1).transpose(0, 2, 1, 3)
+        vv_s = pv_s[pages].reshape(B, V, nkv, 1).transpose(0, 2, 1, 3)
+        qq, q_s = _quant_kv(qg)
+        scores_i = jnp.einsum("bsgrh,bgkh->bgrsk", qq, vk,
+                              preferred_element_type=jnp.int32)
+        scores = (scores_i.astype(jnp.float32)
+                  * q_s[..., 0].transpose(0, 2, 3, 1)[..., None]
+                  * vk_s[..., 0][:, :, None, None, :]) / math.sqrt(hd)
+    else:
+        scores = jnp.einsum(
+            "bsgrh,bgkh->bgrsk", qg, vk,
+            preferred_element_type=jnp.float32) / math.sqrt(hd)
+    pos_kv = jnp.arange(V)
+    vis = pos_kv[None, None, :] <= apos[:, :, None]      # (B, S, V)
+    scores = jnp.where(vis[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if quantized:
+        pvw = probs * vv_s[..., 0][:, :, None, None, :]
+        pvq, pv_sc = _quant_kv(pvw)
+        attn_i = jnp.einsum("bgrsk,bgkh->bsgrh", pvq, vv,
+                            preferred_element_type=jnp.int32)
+        attn = attn_i.astype(jnp.float32) \
+            * pv_sc[..., 0].transpose(0, 3, 1, 2)[..., None]
+    else:
+        attn = jnp.einsum("bgrsk,bgkh->bsgrh", probs.astype(x.dtype), vv,
+                          preferred_element_type=jnp.float32)
+    attn = attn.astype(x.dtype).reshape(B, S, nq * hd)
+    attn_out = dense(attn, layer["wo"])
+    if tp_axis:
+        attn_out = C.all_reduce(attn_out, tp_axis)
+    x = x + attn_out
+
+    r = T.rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
+    mlp, _aux = T._mlp_block(r, layer, cfg=cfg)
+    if tp_axis:
+        mlp = C.all_reduce(mlp, tp_axis)
+    return x + mlp, (pk, pv, pk_s, pv_s)
+
+
+def _paged_forward(params, ids, cfg, bufs: PoolBuffers, pages, apos,
+                   valid, tp_axis=None):
+    """ids (B, S) → (hidden x (B, S, H), bufs') through the UNROLLED
+    layer stack (static layer index into the per-layer pools, like
+    ``generate._forward_cached``)."""
+    x = params["embed"].astype(cfg.dtype)[ids]
+    cos, sin = _ragged_rope_tables(apos, cfg.resolved_head_dim,
+                                   cfg.rope_theta)
+    flags = [(li + 1) % cfg.nope_interval != 0 if cfg.nope_interval
+             else True for li in range(cfg.num_hidden_layers)]
+    ks, vs = list(bufs.k), list(bufs.v)
+    kss = list(bufs.k_scale) if bufs.k_scale is not None else None
+    vss = list(bufs.v_scale) if bufs.v_scale is not None else None
+    for li in range(cfg.num_hidden_layers):
+        layer = jax.tree.map(lambda p: p[li], params["layers"])
+        x, (ks[li], vs[li], ksc, vsc) = _paged_layer_body(
+            x, layer, cfg=cfg, cos=cos, sin=sin,
+            use_rope=bool(flags[li]),
+            pk=ks[li], pv=vs[li],
+            pk_s=kss[li] if kss is not None else None,
+            pv_s=vss[li] if vss is not None else None,
+            pages=pages, apos=apos, valid=valid, tp_axis=tp_axis)
+        if kss is not None:
+            kss[li], vss[li] = ksc, vsc
+    out = PoolBuffers(k=tuple(ks), v=tuple(vs),
+                      k_scale=tuple(kss) if kss is not None else None,
+                      v_scale=tuple(vss) if vss is not None else None)
+    return x, out
+
+
+def _last_logits(params, x_last, cfg):
+    """(B, 1, H) hidden → (B, vocab) fp32 logits, same tail as
+    ``generate._forward_cached``."""
+    x = T.rms_norm(x_last, params["final_norm"], cfg.rms_norm_eps)
+    uq = params.get("unembed_q")
+    if uq is not None:
+        from ..ops.quant import prequantized_dense
+        logits = prequantized_dense(x, uq)[:, 0]
+    else:
+        logits = (x @ T._output_embedding(params, cfg).T)[:, 0]
+    return logits.astype(jnp.float32)
+
+
+def _decode_core(bufs, params, pages, toks, lengths, stop_at, active, *,
+                 cfg, tp_axis=None):
+    """One fixed-shape decode step over every slot.  toks/lengths/
+    stop_at (B,) int32, active (B,) bool.  Emits the next greedy token
+    per ACTIVE slot (inactive slots freeze); a slot auto-retires ON
+    DEVICE when its length reaches ``stop_at`` — the device can never
+    write past a request's page grant even mid-burst, the host only
+    observes retirement at the next sync."""
+    apos = lengths[:, None]
+    x, bufs = _paged_forward(params, toks[:, None], cfg, bufs, pages,
+                             apos, active[:, None], tp_axis=tp_axis)
+    logits = _last_logits(params, x[:, -1:], cfg)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    nxt = jnp.where(active, nxt, toks)
+    new_len = lengths + active.astype(jnp.int32)
+    new_active = jnp.logical_and(active, new_len < stop_at)
+    occ = jnp.sum(active.astype(jnp.int32))
+    return nxt, new_len, new_active, bufs, occ
+
+
+def _prefill_core(bufs, params, pages_row, ids, pos, plen, *, cfg,
+                  tp_axis=None):
+    """One prefill CHUNK for one request: ids (1, C) host-padded with
+    zeros, pos/plen () int32 (chunk start, full prompt length).  Writes
+    the chunk's K/V into the request's pages; rows past the prompt
+    divert to the null page.  Returns the greedy first token — only
+    meaningful on the FINAL chunk (position plen-1 falls inside it)."""
+    Ck = ids.shape[1]
+    apos = pos + jnp.arange(Ck, dtype=jnp.int32)[None, :]
+    valid = apos < plen
+    x, bufs = _paged_forward(params, ids, cfg, bufs, pages_row, apos,
+                             valid, tp_axis=tp_axis)
+    last = jnp.clip(plen - 1 - pos, 0, Ck - 1)
+    xl = jax.lax.dynamic_slice_in_dim(x, last, 1, axis=1)
+    logits = _last_logits(params, xl, cfg)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return tok, bufs
+
+
+# ------------------------------------------------------------- step builders
+
+def make_serve_decode_step(cfg, params=None, *, mesh=None,
+                           tp_axis: str = "tp", pool_spec=None):
+    """The jitted fixed-shape decode step, donated pool buffers.
+    ``mesh`` selects the tensor-parallel shard_map wrapping (params must
+    then be the tree ``parallel.tensor.tp_specs`` describes and
+    ``pool_spec`` the pool's PartitionSpec pytree)."""
+    cfg = _decode_cfg(cfg)
+    if mesh is None:
+        return jax.jit(partial(_decode_core, cfg=cfg, tp_axis=None),
+                       donate_argnums=(0,))
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.tensor import tp_specs
+    core = partial(_decode_core, cfg=cfg, tp_axis=tp_axis)
+    in_specs = (pool_spec, tp_specs(params, tp_axis), P(), P(), P(),
+                P(), P())
+    out_specs = (P(), P(), P(), pool_spec, P())
+    return jax.jit(C.smap(core, mesh, in_specs=in_specs,
+                          out_specs=out_specs), donate_argnums=(0,))
+
+
+def make_serve_prefill_step(cfg, params=None, *, mesh=None,
+                            tp_axis: str = "tp", pool_spec=None):
+    """The jitted single-request prefill-chunk step (see
+    :func:`_prefill_core`)."""
+    cfg = _decode_cfg(cfg)
+    if mesh is None:
+        return jax.jit(partial(_prefill_core, cfg=cfg, tp_axis=None),
+                       donate_argnums=(0,))
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.tensor import tp_specs
+    core = partial(_prefill_core, cfg=cfg, tp_axis=tp_axis)
+    in_specs = (pool_spec, tp_specs(params, tp_axis), P(), P(), P(), P())
+    out_specs = (P(), pool_spec)
+    return jax.jit(C.smap(core, mesh, in_specs=in_specs,
+                          out_specs=out_specs), donate_argnums=(0,))
+
+
+# ------------------------------------------------------------------- engine
+
+class ServingEngine:
+    """Continuous-batching server over the paged pool.
+
+    ``submit()`` requests (with optional virtual ``arrival_s`` offsets),
+    then ``run()`` drives the round loop to completion and returns the
+    finished :class:`scheduler.Request` records; ``slo_report()``
+    aggregates them into the TTFT / per-token-latency percentiles and
+    throughput the SLO table renders.  ``telem``: a
+    ``telemetry.TelemetryRun`` to stream per-round events into
+    (prefill events carry per-request TTFT, decode-burst events carry
+    occupancy/pool gauges and per-request latency at completion)."""
+
+    def __init__(self, params, cfg, *, mesh=None, tp_axis: str = "tp",
+                 max_batch: int = 4, page_size: int = 8,
+                 max_seq_len: int = 64, n_pages: int | None = None,
+                 prefill_chunk: int = 16,
+                 prefill_chunks_per_round: int = 2,
+                 sync_every: int = 4, max_in_flight: int = 8,
+                 kv_quant: bool = False,
+                 hbm_budget_gb: float | None = None,
+                 disaggregate: bool = False, telem=None):
+        self.cfg = _decode_cfg(cfg)
+        self.max_batch = int(max_batch)
+        self.page_size = int(page_size)
+        self.pages_per_request = -(-int(max_seq_len) // self.page_size)
+        # the fixed contraction extent — pass as generate()'s
+        # cache_capacity for bitwise comparison
+        self.view_capacity = self.pages_per_request * self.page_size
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefill_chunks_per_round = int(prefill_chunks_per_round)
+        self.sync_every = max(int(sync_every), 1)
+        self.max_in_flight = int(max_in_flight)
+        self.kv_quant = bool(kv_quant)
+        self.mesh = mesh
+        self.tp_axis = tp_axis if mesh is not None else None
+        self.telem = telem
+        self.disaggregate = bool(disaggregate)
+
+        tp = 1
+        if mesh is not None:
+            if disaggregate:
+                raise ValueError("disaggregate splits devices into "
+                                 "single-program slices; pass mesh=None")
+            from ..parallel.tensor import (check_tp_divisibility,
+                                           shard_params_tp)
+            tp = int(mesh.shape[tp_axis])
+            check_tp_divisibility(self.cfg, tp)
+            if "unembed_q" in params:
+                raise ValueError("tensor-parallel serving takes bf16 "
+                                 "params (int8 weight sharding is not "
+                                 "wired)")
+            params = shard_params_tp(params, mesh, tp_axis)
+
+        if n_pages is None:
+            n_pages = self.max_batch * self.pages_per_request + 1
+            if hbm_budget_gb is not None:
+                from ..utils.memory import tree_size_bytes
+                from .accounting import pool_capacity_pages
+                fit = pool_capacity_pages(
+                    self.cfg, self.page_size, budget_gb=hbm_budget_gb,
+                    weight_bytes=tree_size_bytes(params),
+                    kv_quant=self.kv_quant, tp=tp) + 1
+                n_pages = min(n_pages, fit)
+        if n_pages < self.pages_per_request + 1:
+            raise ValueError(
+                f"pool of {n_pages} pages cannot hold one request "
+                f"({self.pages_per_request} pages + null); raise the "
+                f"HBM budget or shrink max_seq_len")
+        self.n_pages = int(n_pages)
+
+        devs = jax.devices()
+        self._prefill_dev = self._decode_dev = None
+        if self.disaggregate:
+            if len(devs) < 2:
+                raise ValueError("disaggregate needs >= 2 devices")
+            self._prefill_dev = devs[0]
+            self._decode_dev = devs[len(devs) // 2]
+            self._params = jax.device_put(params, self._decode_dev)
+            self._params_pre = jax.device_put(params, self._prefill_dev)
+        else:
+            self._params = params
+            self._params_pre = params
+
+        self.pool = PagedKVPool(self.cfg, self.n_pages, self.page_size,
+                                kv_quant=self.kv_quant, mesh=mesh,
+                                tp_axis=tp_axis, device=self._decode_dev)
+        self.pool_pre = None
+        if self.disaggregate:
+            self.pool_pre = PagedKVPool(
+                self.cfg, self.n_pages, self.page_size,
+                kv_quant=self.kv_quant, device=self._prefill_dev)
+            self._pre_pages: dict[int, list[int]] = {}
+
+        self._decode = make_serve_decode_step(
+            self.cfg, self._params, mesh=mesh, tp_axis=tp_axis,
+            pool_spec=self.pool.spec if mesh is not None else None)
+        self._prefill = make_serve_prefill_step(
+            self.cfg, self._params_pre, mesh=mesh, tp_axis=tp_axis,
+            pool_spec=self.pool.spec if mesh is not None else None)
+        if self.disaggregate:
+            # KV handoff: gather the request's page blocks out of the
+            # prefill pool, ship, scatter into its decode pages.  Full
+            # padded rows keep the programs single-shape; null-row
+            # blocks land on masked positions (exact-zero contribution).
+            def extract(bufs, row):
+                sc = None
+                if bufs.k_scale is not None:
+                    sc = (tuple(s[row] for s in bufs.k_scale),
+                          tuple(s[row] for s in bufs.v_scale))
+                return (tuple(k[row] for k in bufs.k),
+                        tuple(v[row] for v in bufs.v), sc)
+
+            def inject(bufs, blocks, row):
+                bk, bv, sc = blocks
+                ks = vs = None
+                if bufs.k_scale is not None:
+                    ks = tuple(s.at[row].set(b)
+                               for s, b in zip(bufs.k_scale, sc[0]))
+                    vs = tuple(s.at[row].set(b)
+                               for s, b in zip(bufs.v_scale, sc[1]))
+                return PoolBuffers(
+                    k=tuple(p.at[row].set(b)
+                            for p, b in zip(bufs.k, bk)),
+                    v=tuple(p.at[row].set(b)
+                            for p, b in zip(bufs.v, bv)),
+                    k_scale=ks, v_scale=vs)
+
+            self._extract = jax.jit(extract)
+            self._inject = jax.jit(inject, donate_argnums=(0,))
+
+        B, P = self.max_batch, self.pages_per_request
+        self._h_tokens = np.zeros(B, np.int32)
+        self._h_lengths = np.zeros(B, np.int32)
+        self._h_stop = np.zeros(B, np.int32)
+        self._h_active = np.zeros(B, np.bool_)
+        self._h_pages = np.zeros((B, P), np.int32)
+
+        self.batcher = ContinuousBatcher(self.max_batch,
+                                         self.pool.allocator,
+                                         self.page_size)
+        self._pending: list[Request] = []
+        self.completed: list[Request] = []
+        self._rid = 0
+        self._warm_sizes = None
+        self.stats = {"rounds": 0, "decode_steps": 0, "prefill_chunks": 0,
+                      "admit_s": 0.0, "bookkeep_s": 0.0,
+                      "occupancy_sum": 0, "peak_pool_util": 0.0,
+                      "wall_s": 0.0, "host_sync_count": 0}
+
+    # ---- request intake ----------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               arrival_s: float = 0.0) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1 or max_new_tokens < 1:
+            raise ValueError("need >= 1 prompt token and >= 1 new token")
+        if prompt.size + max_new_tokens > self.view_capacity:
+            raise ValueError(
+                f"prompt {prompt.size} + new {max_new_tokens} exceeds "
+                f"the engine's view capacity {self.view_capacity} "
+                f"(raise max_seq_len)")
+        req = Request(rid=self._rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      arrival_s=float(arrival_s))
+        self._rid += 1
+        self._pending.append(req)
+        return req
+
+    # ---- device-put helpers ------------------------------------------
+    def _put(self, x, device=None):
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            return jax.device_put(x, NamedSharding(self.mesh, P()))
+        if device is not None:
+            return jax.device_put(x, device)
+        if self._decode_dev is not None:
+            return jax.device_put(x, self._decode_dev)
+        return jnp.asarray(x)
+
+    # ---- prefill ------------------------------------------------------
+    def _padded_row(self, pages: list[int]) -> np.ndarray:
+        row = np.zeros((1, self.pages_per_request), np.int32)
+        row[0, :len(pages)] = pages
+        return row
+
+    def _prefill_one_chunk(self, req: Request, t0: float) -> None:
+        Ck = self.prefill_chunk
+        pos = req.prefill_pos
+        chunk = req.prompt[pos:pos + Ck]
+        ids = np.zeros((1, Ck), np.int32)
+        ids[0, :chunk.shape[0]] = chunk
+        dev = self._prefill_dev
+        if self.disaggregate:
+            row = self._padded_row(self._pre_pages[req.rid])
+            bufs = self.pool_pre.bufs
+        else:
+            row = self._padded_row(req.pages)
+            bufs = self.pool.bufs
+        t_chunk = time.perf_counter()
+        tok_d, bufs = self._prefill(
+            bufs, self._params_pre, self._put(row, dev),
+            self._put(ids, dev), self._put(np.int32(pos), dev),
+            self._put(np.int32(req.n_prompt), dev))
+        if self.disaggregate:
+            self.pool_pre.bufs = bufs
+        else:
+            self.pool.bufs = bufs
+        req.prefill_pos = min(pos + Ck, req.n_prompt)
+        self.stats["prefill_chunks"] += 1
+        if req.prefill_pos < req.n_prompt:
+            return
+        # final chunk: hand off KV (disaggregated), resolve the first
+        # token — prefill is synchronous at admission, so this blocks
+        # the host by design and stamps TTFT at token resolution
+        if self.disaggregate:
+            dec_row = self._padded_row(req.pages)
+            blocks = self._extract(self.pool_pre.bufs,
+                                   self._put(row[0], self._prefill_dev))
+            blocks = jax.device_put(blocks, self._decode_dev)
+            self.pool.bufs = self._inject(
+                self.pool.bufs, blocks,
+                self._put(dec_row[0], self._decode_dev))
+            self.pool_pre.allocator.free(self._pre_pages.pop(req.rid))
+        first = int(np.asarray(tok_d)[0])   # sync-ok: TTFT resolution
+        self.stats["host_sync_count"] += 1
+        now = time.perf_counter() - t0
+        req.tokens.append(first)
+        req.t_first = now
+        prefill_s = time.perf_counter() - t_chunk
+        if self.telem is not None:
+            self.telem.step(
+                loss=None, tokens=req.n_prompt,
+                tracker_metrics={"last_step_time_s": prefill_s},
+                phase="prefill", rid=req.rid,
+                ttft_ms=round(1e3 * (req.ttft_s or 0.0), 3),
+                pool_util=round(self.pool.utilization, 4))
+        b = req.slot
+        stop = req.n_prompt + req.max_new_tokens - 1
+        if req.n_prompt >= stop:      # max_new == 1: done at prefill
+            req.state = DECODE
+            self.batcher.retire(req, now)
+            self.completed.append(req)
+            self._h_active[b] = False
+            self._h_pages[b] = 0
+            return
+        req.state = DECODE
+        self._h_tokens[b] = first
+        self._h_lengths[b] = req.n_prompt
+        self._h_stop[b] = stop
+        self._h_active[b] = True
+
+    # ---- decode -------------------------------------------------------
+    def _decode_burst(self, pump, t0: float) -> None:
+        sync = self.sync_every
+        L0 = self._h_lengths.copy()
+        A0 = self._h_active.copy()
+        toks_d = self._put(self._h_tokens)
+        len_d = self._put(self._h_lengths)
+        stop_d = self._put(self._h_stop)
+        act_d = self._put(self._h_active)
+        pages_d = self._put(self._h_pages)
+        bufs = self.pool.bufs
+        t_burst = time.perf_counter()
+        step_tokens = []
+        for _ in range(sync):
+            toks_d, len_d, act_d, bufs, occ = self._decode(
+                bufs, self._params, pages_d, toks_d, len_d, stop_d,
+                act_d)
+            pump.emit(occ)
+            step_tokens.append(toks_d)
+        self.pool.bufs = bufs
+        self.stats["decode_steps"] += sync
+        # sync point: the pump just resolved the last step's occupancy,
+        # so the burst's token buffers are (near-)ready — resolve and
+        # replay the device's deterministic active chain on the host
+        mats = [np.asarray(t) for t in step_tokens]   # sync-ok
+        self.stats["host_sync_count"] += 1
+        burst_s = time.perf_counter() - t_burst
+        t_book = time.perf_counter()
+        active, lengths = A0.copy(), L0.copy()
+        occ_burst, emitted = [], 0
+        for j in range(sync):
+            occ_burst.append(int(active.sum()))
+            for b in np.nonzero(active)[0]:
+                self.batcher.slot_request(int(b)).tokens.append(
+                    int(mats[j][b]))
+                emitted += 1
+            lengths = lengths + active
+            active = active & (lengths < self._h_stop)
+        self._h_tokens = mats[-1].copy()
+        self._h_lengths = lengths
+        self._h_active = active
+        now = time.perf_counter() - t0
+        finished = []
+        for b in range(self.max_batch):
+            req = self.batcher.slot_request(b)
+            if req is not None and req.state == DECODE and not active[b]:
+                self.batcher.retire(req, now)
+                self._h_pages[b] = 0     # slot back to the null page
+                self.completed.append(req)
+                finished.append(req)
+        self.stats["bookkeep_s"] += time.perf_counter() - t_book
+        if self.telem is not None:
+            self.telem.step(
+                loss=None, tokens=emitted,
+                tracker_metrics={"last_step_time_s": burst_s / sync},
+                phase="decode",
+                active=round(float(np.mean(occ_burst)), 3),
+                admitted=self.batcher.admitted_total,
+                completed=self.batcher.completed_total,
+                kv_pages_in_use=self.pool.allocator.pages_in_use,
+                pool_util=round(self.pool.utilization, 4),
+                completed_requests=[
+                    {"rid": r.rid,
+                     "ttft_ms": round(1e3 * (r.ttft_s or 0.0), 3),
+                     "per_token_ms": round(1e3 * (r.per_token_s or 0.0),
+                                           3),
+                     "tokens": len(r.tokens)} for r in finished])
+
+    # ---- round loop ---------------------------------------------------
+    def run(self) -> list[Request]:
+        from ..runtime.pump import StepPump
+
+        pending = sorted(self._pending, key=lambda r: r.arrival_s)
+        self._pending = []
+        t0 = time.perf_counter()
+        pump = StepPump(mode="async", sync_every=self.sync_every,
+                        max_in_flight=self.max_in_flight)
+        newly_done_base = len(self.completed)
+        try:
+            while pending or self.batcher.has_work():
+                now = time.perf_counter() - t0
+                while pending and pending[0].arrival_s <= now:
+                    self.batcher.submit(pending.pop(0), now)
+                if not self.batcher.has_work():
+                    # idle until the next virtual arrival
+                    time.sleep(min(max(pending[0].arrival_s - now, 0.0),
+                                   0.05))
+                    continue
+                t_admit = time.perf_counter()
+                admitted = self.batcher.admit(now)
+                for req in admitted:
+                    # install the slot's page-table row in the host
+                    # mirror the decode burst ships (unused entries
+                    # point at the null page)
+                    self._h_pages[req.slot] = 0
+                    self._h_pages[req.slot, :len(req.pages)] = req.pages
+                    if self.disaggregate:
+                        n = -(-req.n_prompt // self.page_size)
+                        pre = self.pool_pre.allocator.alloc(n)
+                        if pre is None:
+                            raise RuntimeError(
+                                "prefill pool exhausted — it is sized "
+                                "like the decode pool, so this is a "
+                                "leak, not load")
+                        self._pre_pages[req.rid] = pre
+                self.stats["admit_s"] += time.perf_counter() - t_admit
+                for _ in range(self.prefill_chunks_per_round):
+                    req = self.batcher.next_prefill()
+                    if req is None:
+                        break
+                    self._prefill_one_chunk(req, t0)
+                if self._h_active.any():
+                    self._decode_burst(pump, t0)
+                self.stats["rounds"] += 1
+                self.stats["occupancy_sum"] += int(self._h_active.sum())
+                self.stats["peak_pool_util"] = max(
+                    self.stats["peak_pool_util"], self.pool.utilization)
+                if self._warm_sizes is None \
+                        and self.stats["decode_steps"] > 0:
+                    self._warm_sizes = self._jit_sizes()
+        finally:
+            pump.close()
+            self.stats["host_sync_count"] += pump.host_sync_count
+        self.stats["wall_s"] += time.perf_counter() - t0
+        return self.completed[newly_done_base:]
+
+    def _jit_sizes(self) -> dict:
+        from ..analysis.recompile import jit_cache_size
+        fns = {"decode": self._decode, "prefill": self._prefill}
+        if self.disaggregate:
+            fns["extract"] = self._extract
+            fns["inject"] = self._inject
+        return {k: jit_cache_size(f) for k, f in fns.items()}
+
+    # ---- reporting ----------------------------------------------------
+    def retraces_after_warmup(self) -> int | None:
+        """Jit-cache growth since the first round finished — 0 is the
+        contract (admit/evict over the whole trace never retraces);
+        None before any decode ran or when the cache is unreadable."""
+        if self._warm_sizes is None:
+            return None
+        cur = self._jit_sizes()
+        known = [(w, cur[k]) for k, w in self._warm_sizes.items()
+                 if w is not None and cur.get(k) is not None]
+        if not known:
+            return None
+        return sum(c - w for w, c in known)
+
+    def slo_report(self) -> dict:
+        """TTFT / per-token percentiles + throughput + pool/scheduler
+        health for the finished requests — the dict ``serve_bench``
+        files under summary.json's ``serving`` key."""
+        done = [r for r in self.completed if r.t_done is not None]
+        ttft = np.array([r.ttft_s for r in done
+                         if r.ttft_s is not None]) * 1e3
+        ptl = np.array([r.per_token_s for r in done
+                        if r.per_token_s is not None]) * 1e3
+        pct = lambda a, q: (round(float(np.percentile(a, q)), 3)
+                            if a.size else None)
+        toks = int(sum(len(r.tokens) for r in done))
+        wall = self.stats["wall_s"] or 1e-9
+        ndev = len(jax.devices()) if self.mesh is None \
+            else int(self.mesh.devices.size)
+        steps = max(self.stats["decode_steps"], 1)
+        return {
+            "requests": self.batcher.admitted_total,
+            "completed": len(done),
+            "ttft_ms": {"p50": pct(ttft, 50), "p99": pct(ttft, 99)},
+            "per_token_ms": {"p50": pct(ptl, 50), "p99": pct(ptl, 99)},
+            "tokens_total": toks,
+            "tokens_per_s": round(toks / wall, 2),
+            "tokens_per_s_per_device": round(toks / wall / ndev, 2),
+            "devices": ndev,
+            "pool": {"n_pages": self.n_pages,
+                     "page_size": self.page_size,
+                     "peak_util": round(self.stats["peak_pool_util"], 4)},
+            "scheduler": {
+                "rounds": self.stats["rounds"],
+                "decode_steps": self.stats["decode_steps"],
+                "prefill_chunks": self.stats["prefill_chunks"],
+                "admit_ms_total": round(1e3 * self.stats["admit_s"], 3),
+                "bookkeep_ms_total": round(
+                    1e3 * self.stats["bookkeep_s"], 3),
+                "mean_occupancy": round(
+                    self.stats["occupancy_sum"]
+                    / max(self.stats["rounds"], 1), 3),
+                "host_syncs": self.stats["host_sync_count"],
+            },
+            "disaggregated": self.disaggregate,
+            "kv_quant": self.kv_quant,
+            "recompiles_after_warmup": self.retraces_after_warmup(),
+        }
+
+
+def serve(params, cfg, prompts, *, max_new_tokens: int = 16,
+          **engine_kwargs) -> list[np.ndarray]:
+    """One-call convenience: build an engine, run every prompt to
+    completion, return each continuation as an int32 array (in prompt
+    order)."""
+    eng = ServingEngine(params, cfg, **engine_kwargs)
+    reqs = [eng.submit(p, max_new_tokens=max_new_tokens)
+            for p in prompts]
+    eng.run()
+    return [np.asarray(r.tokens, np.int32) for r in reqs]

@@ -1,0 +1,157 @@
+"""Sharded paged KV cache pool — the serving-side cache substrate.
+
+One-shot decode (``models/generate.py``) gives every request a private
+``(B, n_kv, S_max, hd)`` cache sized to its own prompt+new.  A server
+cannot: requests arrive and finish continuously, so the cache must be a
+FIXED pool whose blocks are reassigned between requests without
+reallocating (or retracing) anything.  vLLM's paged layout, TPU-shaped:
+
+  * per-layer POOLS of page blocks, ``(n_pages, page_size, n_kv, hd)``
+    in ``cfg.dtype`` — or int8 codes + ``(n_pages, page_size, n_kv, 1)``
+    f32 row scales via the same ``_quant_kv`` row quantizer the one-shot
+    int8 cache uses;
+  * a host-side PAGE TABLE per request slot: absolute position ``p`` of
+    a request lives at ``(page_table[slot, p // page_size],
+    p % page_size)``;
+  * page 0 is RESERVED as the null page: writes for padded/inactive
+    positions are diverted there (a scatter must always have a target —
+    static shapes), and unassigned page-table entries point at it, so
+    reads of dead slots land on masked garbage, never out of bounds;
+  * under tensor parallelism the head axis (dim 2) is sharded over the
+    mesh's ``tp`` axis — the same each-rank-caches-its-local-heads
+    layout ``init_cache(tp=...)`` uses, so pool memory and per-step
+    cache reads shrink by tp.
+
+The device arrays live in a :class:`PoolBuffers` namedtuple that the
+jitted decode/prefill steps DONATE and return — the pool object just
+tracks the current buffers plus the free list.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class PoolBuffers(NamedTuple):
+    """The device half of the pool: per-layer page-block arrays (tuples
+    of L arrays, mirroring ``KVCache``'s per-layer-buffer decision — a
+    stacked (L, ...) layout would pay a dynamic-slice copy per layer per
+    step).  ``k_scale``/``v_scale`` are the f32 row scales of the int8
+    pool, None for the ``cfg.dtype`` pool."""
+    k: tuple            # L × (n_pages, page_size, n_kv, hd)
+    v: tuple
+    k_scale: tuple | None   # L × (n_pages, page_size, n_kv, 1) f32
+    v_scale: tuple | None
+
+
+class PageAllocator:
+    """Host-side free list over pages ``1..n_pages-1`` (page 0 is the
+    reserved null page).  LIFO reuse keeps recently-touched pages warm;
+    allocation is all-or-nothing so a request can never deadlock holding
+    a partial page set."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError(f"need >= 2 pages (1 null + 1 usable), "
+                             f"got {n_pages}")
+        self.n_pages = n_pages
+        self._free = list(range(n_pages - 1, 0, -1))
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` pages or None — never a partial grant."""
+        if n <= 0:
+            raise ValueError(f"alloc({n})")
+        if len(self._free) < n:
+            return None
+        got = self._free[-n:]
+        del self._free[-n:]
+        return got
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if not 0 < p < self.n_pages:
+                raise ValueError(f"freeing invalid page {p}")
+        self._free.extend(pages)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.n_pages - 1) - len(self._free)
+
+    @property
+    def utilization(self) -> float:
+        usable = self.n_pages - 1
+        return self.pages_in_use / usable if usable else 0.0
+
+
+class PagedKVPool:
+    """Device pools + allocator + (optional) mesh sharding.
+
+    ``mesh``/``tp_axis``: shard the head axis over ``tp_axis`` via a
+    NamedSharding — the buffers stay one logical array addressed by the
+    engine's ``shard_map`` step.  ``device``: commit the pool to one
+    device (the disaggregated prefill/decode slices).  Neither: default
+    placement."""
+
+    def __init__(self, cfg, n_pages: int, page_size: int, *,
+                 kv_quant: bool = False, mesh=None, tp_axis: str = "tp",
+                 device=None):
+        if mesh is not None and device is not None:
+            raise ValueError("pass mesh or device, not both")
+        self.cfg = cfg
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.kv_quant = bool(kv_quant)
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self.device = device
+        L = cfg.num_hidden_layers
+        nkv, hd = cfg.num_key_value_heads, cfg.resolved_head_dim
+        shape = (self.n_pages, self.page_size, nkv, hd)
+        dt = jnp.int8 if kv_quant else cfg.dtype
+        put = self._put
+        k = tuple(put(jnp.zeros(shape, dt)) for _ in range(L))
+        v = tuple(put(jnp.zeros(shape, dt)) for _ in range(L))
+        # scales init to ones like init_cache's — unwritten rows then
+        # dequantize to exact zeros, matching the one-shot cache
+        ks = vs = None
+        if kv_quant:
+            ks = tuple(put(jnp.ones(shape[:-1] + (1,), jnp.float32))
+                       for _ in range(L))
+            vs = tuple(put(jnp.ones(shape[:-1] + (1,), jnp.float32))
+                       for _ in range(L))
+        self.bufs = PoolBuffers(k=k, v=v, k_scale=ks, v_scale=vs)
+        self.allocator = PageAllocator(self.n_pages)
+
+    def _put(self, x):
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            return jax.device_put(
+                x, NamedSharding(self.mesh,
+                                 P(None, None, self.tp_axis, None)))
+        if self.device is not None:
+            return jax.device_put(x, self.device)
+        return x
+
+    @property
+    def spec(self) -> PoolBuffers:
+        """PartitionSpec pytree matching ``bufs`` — the in/out spec the
+        engine hands ``shard_map`` (heads sharded over tp, everything
+        else replicated)."""
+        from jax.sharding import PartitionSpec as P
+        L = self.cfg.num_hidden_layers
+        ps = P(None, None, self.tp_axis if self.mesh is not None else None,
+               None)
+        sc = (ps,) * L if self.kv_quant else None
+        return PoolBuffers(k=(ps,) * L, v=(ps,) * L, k_scale=sc,
+                           v_scale=sc)
+
+    @property
+    def utilization(self) -> float:
+        return self.allocator.utilization

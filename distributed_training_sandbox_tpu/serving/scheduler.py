@@ -1,0 +1,157 @@
+"""Continuous-batching scheduler: the per-request state machine.
+
+Requests move WAITING → PREFILL → DECODE → DONE.  The device-side decode
+step has STATIC shape — ``max_batch`` slots, an active mask — so
+admission and eviction are pure host bookkeeping between decode bursts:
+a fresh slot's token/length/page-table rows are rewritten and the next
+burst's ``device_put`` ships the same-shaped arrays (zero retraces, the
+recompile watch in ``serve_bench`` proves it over a whole trace).
+
+Admission policy: FCFS with head-of-line blocking, and ALL pages a
+request can ever need — ``ceil((prompt + max_new) / page_size)`` — are
+granted at admit time.  Lazier per-token growth would pack more
+requests in, but a request mid-decode could then hit an empty free list
+and must be preempted (re-prefilled later); granting up front makes
+admitted requests run to completion unconditionally, which is the right
+trade at this repo's tier and keeps the engine's device loop free of
+page-fault paths.  Eviction (page + slot release) happens at the sync
+point where a request's emission count reaches ``max_new``.
+
+Timestamps are elapsed seconds on the engine's clock: ``t_submit`` is
+the request's (virtual) arrival, ``t_first`` when its first token
+resolved on the host (prefill is synchronous at admission, so TTFT is
+measured at token resolution), ``t_done`` at the retiring sync point —
+so per-token latency is measured at sync granularity, the price of the
+pump's bounded-async dispatch.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .kv_pool import PageAllocator
+
+WAITING = "WAITING"
+PREFILL = "PREFILL"
+DECODE = "DECODE"
+DONE = "DONE"
+
+
+@dataclass
+class Request:
+    """One generation request plus its runtime state.  ``tokens`` holds
+    the emitted ids (greedy continuation of ``prompt``); the first entry
+    comes from the prefill's last-position logits."""
+    rid: int
+    prompt: np.ndarray          # (S0,) int32
+    max_new_tokens: int
+    arrival_s: float = 0.0
+
+    state: str = WAITING
+    slot: int | None = None
+    pages: list[int] | None = None
+    prefill_pos: int = 0
+    tokens: list[int] = field(default_factory=list)
+    t_submit: float | None = None
+    t_admit: float | None = None
+    t_first: float | None = None
+    t_done: float | None = None
+
+    @property
+    def n_prompt(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def ttft_s(self) -> float | None:
+        if self.t_first is None or self.t_submit is None:
+            return None
+        return self.t_first - self.t_submit
+
+    @property
+    def per_token_s(self) -> float | None:
+        """Mean decode latency per token AFTER the first (TTFT owns the
+        first); sync-granular — see the module docstring."""
+        if self.t_done is None or self.t_first is None:
+            return None
+        return (self.t_done - self.t_first) / max(len(self.tokens) - 1, 1)
+
+
+class ContinuousBatcher:
+    """Slot + page bookkeeping for the engine.  Owns the waiting queue,
+    the ``max_batch`` slot table and the page allocator; knows nothing
+    about devices."""
+
+    def __init__(self, max_batch: int, allocator: PageAllocator,
+                 page_size: int):
+        self.max_batch = int(max_batch)
+        self.allocator = allocator
+        self.page_size = int(page_size)
+        self.waiting: deque[Request] = deque()
+        self.slots: list[Request | None] = [None] * self.max_batch
+        self.admitted_total = 0
+        self.completed_total = 0
+
+    # ---- queries ------------------------------------------------------
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(
+            r is not None for r in self.slots)
+
+    def slot_request(self, b: int) -> Request | None:
+        return self.slots[b]
+
+    def next_prefill(self) -> Request | None:
+        """The oldest request still in PREFILL (chunked prefill drains
+        FCFS — one long prompt can't starve, it just shares rounds)."""
+        cands = [r for r in self.slots
+                 if r is not None and r.state == PREFILL]
+        return min(cands, key=lambda r: r.t_admit) if cands else None
+
+    def pages_needed(self, req: Request) -> int:
+        total = req.n_prompt + req.max_new_tokens
+        return -(-total // self.page_size)
+
+    # ---- transitions --------------------------------------------------
+    def submit(self, req: Request, now: float) -> None:
+        req.state = WAITING
+        req.t_submit = req.arrival_s if req.arrival_s else now
+        self.waiting.append(req)
+
+    def admit(self, now: float) -> list[Request]:
+        """FCFS: admit while a slot AND the full page grant are free.
+        Head-of-line blocking is deliberate — skipping ahead would
+        starve long requests under load."""
+        admitted = []
+        while self.waiting:
+            free = [b for b, r in enumerate(self.slots) if r is None]
+            if not free:
+                break
+            req = self.waiting[0]
+            pages = self.allocator.alloc(self.pages_needed(req))
+            if pages is None:
+                break
+            self.waiting.popleft()
+            req.pages = pages
+            req.slot = free[0]
+            req.state = PREFILL
+            req.prefill_pos = 0
+            req.t_admit = now
+            self.slots[req.slot] = req
+            self.admitted_total += 1
+            admitted.append(req)
+        return admitted
+
+    def retire(self, req: Request, now: float) -> None:
+        """DONE: release the slot and every page (eviction between
+        decode bursts — the device never sees it, only the next burst's
+        rewritten host arrays do)."""
+        assert req.slot is not None and self.slots[req.slot] is req
+        self.slots[req.slot] = None
+        self.allocator.free(req.pages)
+        req.pages = None
+        req.slot = None
+        req.state = DONE
+        req.t_done = now
+        self.completed_total += 1

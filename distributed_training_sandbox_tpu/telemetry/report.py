@@ -145,6 +145,10 @@ def run_row(rec: dict) -> dict:
         row["overlap_fraction"] = sp["overlap_fraction"]
     if summ.get("host_sync_count") is not None:
         row["host_sync_count"] = summ["host_sync_count"]
+    # serving SLO block (serving.ServingEngine.slo_report, filed by
+    # scripts/serve_bench.py) — rendered as its own section
+    if summ.get("serving") is not None:
+        row["serving"] = summ["serving"]
     return row
 
 
@@ -262,6 +266,44 @@ def render_table(rows: list[dict]) -> str:
             f"| {_fmt(100 * ovl if ovl is not None else None, '.1f')} "
             f"| {_fmt(r.get('host_sync_count'), 'd')} "
             f"| {cc_cell} | {r.get('status', '—')} |")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------- serving
+
+def render_serving(rows: list[dict]) -> str:
+    """Latency-SLO table for every run that filed a ``serving`` block
+    (``serving.ServingEngine.slo_report`` via ``scripts/serve_bench.py``):
+    TTFT / per-token percentiles, throughput per device, pool and
+    scheduler health, and the recompile watch's verdict."""
+    srows = [r for r in rows if r.get("serving")]
+    if not srows:
+        return "_no serving runs_"
+    out = ["| run | reqs | done | TTFT p50/p99 ms | tok p50/p99 ms | "
+           "tok/s | tok/s/dev | occ | pool peak | retraces | mode |",
+           "|---|---|---|---|---|---|---|---|---|---|---|"]
+    for r in sorted(srows, key=lambda r: r.get("run_id") or ""):
+        s = r["serving"]
+        ttft = s.get("ttft_ms") or {}
+        ptl = s.get("per_token_ms") or {}
+        sched = s.get("scheduler") or {}
+        pool = s.get("pool") or {}
+        rt = s.get("recompiles_after_warmup")
+        mode = "disagg" if s.get("disaggregated") else "unified"
+        if s.get("kv_quant"):
+            mode += "+kvq"
+        out.append(
+            f"| {r.get('run_id', '—')} "
+            f"| {_fmt(s.get('requests'), 'd')} "
+            f"| {_fmt(s.get('completed'), 'd')} "
+            f"| {_fmt(ttft.get('p50'), '.1f')}/{_fmt(ttft.get('p99'), '.1f')} "
+            f"| {_fmt(ptl.get('p50'), '.2f')}/{_fmt(ptl.get('p99'), '.2f')} "
+            f"| {_fmt(s.get('tokens_per_s'), '.1f')} "
+            f"| {_fmt(s.get('tokens_per_s_per_device'), '.2f')} "
+            f"| {_fmt(sched.get('mean_occupancy'), '.2f')} "
+            f"| {_fmt(pool.get('peak_util'), '.2f')} "
+            f"| {'0 ✓' if rt == 0 else _fmt(rt, 'd') if rt is not None else '—'} "
+            f"| {mode} |")
     return "\n".join(out)
 
 

@@ -14,6 +14,21 @@ Field semantics:
   ``tokens_per_second`` cumulative post-warmup rate (tracker window)
   ``tflops_per_device`` analytic-FLOPs rate per device (None w/o model)
   ``peak_memory_gb``    allocator peak on device 0 (None on CPU sim)
+
+Serving events (``serving.engine`` — one line per prefill completion or
+decode burst) ride the same schema with the optional fields below;
+``step`` counts engine events, ``tokens`` are prompt tokens (prefill)
+or emitted tokens (decode burst), ``step_time_s`` the chunk / per-step
+burst time:
+  ``phase``            "prefill" | "decode"
+  ``active``           mean active slots over the burst
+  ``admitted``         requests admitted so far
+  ``completed``        requests retired so far
+  ``kv_pages_in_use``  pool pages currently granted
+  ``pool_util``        granted / usable pages (0..1)
+  ``ttft_ms``          this request's time-to-first-token (prefill)
+  ``completed_requests`` per-request {rid, ttft_ms, per_token_ms,
+                       tokens} retired at this burst's sync point
 """
 
 from __future__ import annotations
@@ -32,6 +47,15 @@ STEP_FIELDS = {
     "tokens_per_second": False,
     "tflops_per_device": False,
     "peak_memory_gb": False,
+    # serving-runtime extras (absent on training events)
+    "phase": False,
+    "active": False,
+    "admitted": False,
+    "completed": False,
+    "kv_pages_in_use": False,
+    "pool_util": False,
+    "ttft_ms": False,
+    "completed_requests": False,
 }
 
 
@@ -70,7 +94,9 @@ def validate_step(ev: dict) -> list[str]:
     if ev.get("schema") not in (None, STEP_SCHEMA_VERSION):
         problems.append(f"unknown schema version {ev.get('schema')!r}")
     for field in ("loss", "step_time_s", "tokens_per_second",
-                  "tflops_per_device", "peak_memory_gb"):
+                  "tflops_per_device", "peak_memory_gb", "active",
+                  "admitted", "completed", "kv_pages_in_use",
+                  "pool_util", "ttft_ms"):
         v = ev.get(field)
         if v is not None and not isinstance(v, (int, float)):
             problems.append(f"{field} must be numeric or null, got {v!r}")

@@ -31,34 +31,17 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 HBM_GBPS = {"tpu": 819.0}
 
 
+# The per-step byte formulas live in serving.accounting now — one home
+# shared with the serving pool's capacity planner, so the roofline here
+# and the pool sizing there cannot drift.
+from distributed_training_sandbox_tpu.serving.accounting import (  # noqa: E402,F401
+    kv_bytes_per_step, weight_read_bytes)
+
+
 def weight_bytes(params) -> int:
     from distributed_training_sandbox_tpu.utils.memory import (
         tree_size_bytes)
     return tree_size_bytes(params)
-
-
-def kv_bytes_per_step(cfg, batch: int, s_max: int, kv_quant: bool) -> int:
-    """HBM bytes the attention READS from the KV cache per decode step:
-    batch × S_max × layers × n_kv × hd × 2 (K and V) × itemsize.  The
-    cache is a static (B, S_max, ...) buffer, so every step reads the
-    whole capacity (masked), not just the live prefix — the honest
-    denominator.  int8 cache adds the f32 row scales (hd→4 bytes)."""
-    elems = batch * s_max * cfg.num_hidden_layers \
-        * cfg.num_key_value_heads * cfg.resolved_head_dim * 2
-    if kv_quant:
-        return elems + (elems // cfg.resolved_head_dim) * 4
-    return elems * 2          # bf16
-
-
-def weight_read_bytes(cfg, params, wb: int) -> int:
-    """Weight bytes a decode STEP actually reads: the embedding table is
-    only GATHERED (B rows) per step, so when a separate unembedding
-    exists (int8 decode's ``unembed_q``, or an untied ``lm_head``) the
-    embed bytes drop out of the per-step read.  Tied bf16 decode reads
-    the table as the unembedding matmul, so it stays."""
-    if "unembed_q" in params or "lm_head" in params:
-        return wb - cfg.vocab_size * cfg.hidden_size * 2   # bf16 embed
-    return wb
 
 
 def run_one(cfg, params, precision: str, batch: int, prompt_len: int,

@@ -36,6 +36,11 @@ def main(argv=None):
     p.add_argument("--temperature", type=float, default=0.7)
     p.add_argument("--int8", action="store_true",
                    help="decode with int8-stored weights")
+    p.add_argument("--serve", action="store_true",
+                   help="also push the prompt through the serving "
+                        "runtime (continuous-batching engine) and "
+                        "record whether its greedy continuation matches "
+                        "one-shot generate bitwise")
     p.add_argument("--cpu-devices", type=int, default=0)
     p.add_argument("--out-file", default=None)
     args = p.parse_args(argv)
@@ -98,6 +103,27 @@ def main(argv=None):
            "prompt": args.prompt, "prompt_ids": ids, "int8": args.int8,
            "max_new_tokens": args.max_new_tokens, "samples": samples,
            "sample_ids": sample_ids}
+
+    if args.serve:
+        # the serving smoke: same prompt through the continuous-batching
+        # engine, compared bitwise against a capacity-pinned one-shot
+        # generate (serving.engine's parity contract, on real weights)
+        from distributed_training_sandbox_tpu.serving import ServingEngine
+        eng = ServingEngine(
+            params, mcfg, max_batch=2, page_size=16,
+            max_seq_len=len(ids) + args.max_new_tokens)
+        req = eng.submit(np.asarray(ids, np.int32),
+                         max_new_tokens=args.max_new_tokens)
+        eng.run()
+        record("serve_greedy", np.asarray(req.tokens, np.int32))
+        ref = np.asarray(generate(
+            params, prompt_ids, mcfg,
+            max_new_tokens=args.max_new_tokens,
+            cache_capacity=eng.view_capacity))[0]
+        out["serve_matches_greedy"] = bool(
+            len(req.tokens) == ref.shape[0]
+            and (np.asarray(req.tokens, np.int32) == ref).all())
+        out["serve_slo"] = eng.slo_report()
     print(json.dumps(out, indent=1))
     if args.out_file:
         Path(args.out_file).write_text(json.dumps(out, indent=1))

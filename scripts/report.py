@@ -111,6 +111,9 @@ def main(argv=None) -> int:
         print(f"# Telemetry report — {len(rows)} run(s) from "
               f"{', '.join(args.paths)}\n")
         print(R.render_table(rows))
+        if any(r.get("serving") for r in rows):
+            print("\n## Serving SLO (TTFT / per-token latency)\n")
+            print(R.render_serving(rows))
         if any(r.get("lineage") for r in rows):
             print("\n## Restart lineage (stitched segments)\n")
             print(R.render_lineage(rows))

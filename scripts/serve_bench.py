@@ -1,0 +1,179 @@
+"""Serving benchmark: Poisson traffic through the continuous-batching
+engine, with the latency-SLO report and two hard gates.
+
+Drives ``serving.ServingEngine`` with a seeded open-loop trace —
+exponential inter-arrivals at ``--rate``, bimodal prompt lengths (chat
+short / document long), uniform ``max_new`` — and files the engine's
+SLO report (p50/p99 TTFT, p50/p99 per-token latency, tokens/s/device,
+pool utilization, scheduler overhead) under the run's ``summary.json``
+``serving`` key, so ``scripts/report.py`` renders it next to the
+training runs.  Per-request TTFT and per-burst latency stream into
+``steps.jsonl`` as the run goes.
+
+Exit is nonzero when either serving invariant breaks:
+  * **recompiles**: any jit-cache growth after the first round — the
+    static-shape contract (admit/evict over the whole trace must never
+    retrace);
+  * **parity** (``--check-parity N``): the first N finished requests'
+    tokens must be BITWISE equal to one-shot ``generate`` of the same
+    prompt at the engine's pinned ``cache_capacity``.
+
+    python scripts/serve_bench.py --requests 64 --rate 16 --tp 2
+    python scripts/serve_bench.py --requests 8 --disaggregate
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def build_trace(rng, n_requests: int, rate: float, vocab: int,
+                max_seq_len: int):
+    """(arrival_s, prompt, max_new) triples: Poisson arrivals, bimodal
+    prompt lengths (70 % chat-short 4–16, 30 % document-long 24–48,
+    clipped to capacity), 4–24 new tokens."""
+    t = 0.0
+    trace = []
+    for _ in range(n_requests):
+        t += float(rng.exponential(1.0 / rate))
+        long = rng.random() < 0.3
+        plen = int(rng.integers(24, 49) if long else rng.integers(4, 17))
+        new = int(rng.integers(4, 25))
+        plen = min(plen, max_seq_len - new)
+        prompt = rng.integers(1, vocab, size=plen)
+        trace.append((t, prompt.astype("int32"), new))
+    return trace
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Poisson traffic through the serving runtime + SLO "
+                    "report")
+    p.add_argument("--model", default="TINY_LM")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--rate", type=float, default=16.0,
+                   help="mean arrival rate, requests/s (default 16)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--page-size", type=int, default=8)
+    p.add_argument("--max-seq-len", type=int, default=80)
+    p.add_argument("--prefill-chunk", type=int, default=16)
+    p.add_argument("--sync-every", type=int, default=4)
+    p.add_argument("--tp", type=int, default=0,
+                   help="tensor-parallel degree (0 = single program; "
+                        "N shards heads over a dp × tp mesh)")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="int8 paged KV pool (+f32 row scales)")
+    p.add_argument("--disaggregate", action="store_true",
+                   help="prefill/decode on separate device slices with "
+                        "page-block KV handoff")
+    p.add_argument("--hbm-budget-gb", type=float, default=None,
+                   help="cap the pool via the capacity planner "
+                        "(serving.accounting.pool_capacity_pages)")
+    p.add_argument("--check-parity", type=int, default=4, metavar="N",
+                   help="verify the first N finished requests bitwise "
+                        "against one-shot generate (0 disables)")
+    p.add_argument("--param-scale", type=float, default=3.0,
+                   help="scale random init weights — ~3 makes greedy "
+                        "trajectories chaotic, so the parity check "
+                        "discriminates (1.0 = raw init, which settles "
+                        "on a constant token)")
+    args = p.parse_args(argv)
+
+    import jax
+    import numpy as np
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.models.generate import generate
+    from distributed_training_sandbox_tpu.serving import ServingEngine
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
+    from distributed_training_sandbox_tpu.utils import make_mesh
+
+    cfg = getattr(T, args.model)
+    key = jax.random.PRNGKey(args.seed)
+    params = T.init_params(key, cfg)
+    if args.param_scale != 1.0:
+        params = jax.tree.map(
+            lambda x: (x * args.param_scale).astype(x.dtype), params)
+
+    mesh = None
+    if args.tp > 1:
+        n_dev = len(jax.devices())
+        if n_dev % args.tp:
+            print(f"[serve] {n_dev} devices not divisible by tp="
+                  f"{args.tp}", file=sys.stderr)
+            return 2
+        mesh = make_mesh({"dp": n_dev // args.tp, "tp": args.tp},
+                         register=False)
+
+    rng = np.random.default_rng(args.seed)
+    trace = build_trace(rng, args.requests, args.rate, cfg.vocab_size,
+                        args.max_seq_len)
+
+    run_cfg = {"num_steps": 0, "batch_size": args.max_batch,
+               "sequence_length": args.max_seq_len, "seed": args.seed,
+               "requests": args.requests, "rate": args.rate,
+               "page_size": args.page_size, "tp": args.tp,
+               "kv_quant": args.kv_quant,
+               "disaggregate": args.disaggregate}
+    failures = []
+    with TelemetryRun("serving", model=args.model, mesh=mesh,
+                      config=run_cfg) as telem:
+        eng = ServingEngine(
+            params, cfg, mesh=mesh, max_batch=args.max_batch,
+            page_size=args.page_size, max_seq_len=args.max_seq_len,
+            prefill_chunk=args.prefill_chunk,
+            sync_every=args.sync_every, kv_quant=args.kv_quant,
+            hbm_budget_gb=args.hbm_budget_gb,
+            disaggregate=args.disaggregate, telem=telem)
+        reqs = [eng.submit(prompt, max_new_tokens=new, arrival_s=t)
+                for t, prompt, new in trace]
+        eng.run()
+        slo = eng.slo_report()
+        print(f"[serve] {slo['completed']}/{slo['requests']} requests, "
+              f"TTFT p50 {slo['ttft_ms']['p50']} ms p99 "
+              f"{slo['ttft_ms']['p99']} ms, per-token p50 "
+              f"{slo['per_token_ms']['p50']} ms, "
+              f"{slo['tokens_per_s']} tok/s "
+              f"({slo['tokens_per_s_per_device']}/device)", flush=True)
+
+        retr = slo["recompiles_after_warmup"]
+        if retr is None or retr > 0:
+            failures.append(f"jit cache grew after warmup: {retr}")
+        if slo["completed"] != args.requests:
+            failures.append(f"only {slo['completed']}/{args.requests} "
+                            f"requests completed")
+
+        for req in reqs[:args.check_parity]:
+            ref = np.asarray(generate(
+                params, req.prompt[None], cfg,
+                max_new_tokens=req.max_new_tokens,
+                kv_quant=args.kv_quant,
+                cache_capacity=eng.view_capacity))[0]
+            got = np.asarray(req.tokens, np.int32)
+            if got.shape != ref.shape or not (got == ref).all():
+                failures.append(
+                    f"rid {req.rid}: tokens diverge from one-shot "
+                    f"generate (got {got.tolist()[:8]}..., ref "
+                    f"{ref.tolist()[:8]}...)")
+        if args.check_parity:
+            print(f"[serve] parity vs generate: "
+                  f"{min(args.check_parity, len(reqs))} request(s) "
+                  f"{'OK' if not failures else 'CHECKED (see failures)'}",
+                  flush=True)
+        slo["parity_checked"] = min(args.check_parity, len(reqs))
+        slo["failures"] = failures
+        telem.finalize(serving=slo)
+
+    print(json.dumps(slo, indent=1))
+    for f in failures:
+        print(f"[serve] FAIL: {f}", file=sys.stderr, flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,299 @@
+"""Serving runtime suite: paged-pool bookkeeping, the shared accounting
+module, scheduler state machine, and THE acceptance property — every
+request served by the continuous-batching engine is BITWISE identical to
+a one-shot ``generate`` of the same prompt at the engine's pinned cache
+capacity, across ragged batches, admit/evict churn, tensor parallelism,
+int8 KV, and the disaggregated prefill/decode split — plus the
+zero-retraces gate and the SLO telemetry wiring."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_sandbox_tpu.models import transformer as T
+from distributed_training_sandbox_tpu.models.generate import generate
+from distributed_training_sandbox_tpu.serving import (
+    ContinuousBatcher, PageAllocator, PagedKVPool, Request, ServingEngine,
+    kv_bytes_per_step, page_bytes, pool_capacity_pages, serve_waterline_gb)
+
+pytestmark = pytest.mark.serving
+
+
+def _chaotic_params(cfg, seed=0, scale=3.0):
+    """Raw TINY_LM init settles on a constant greedy token (weak parity
+    discrimination); 3x-scaled weights give chaotic trajectories where a
+    single-ulp drift flips the continuation."""
+    params = T.init_params(jax.random.PRNGKey(seed), cfg)
+    return jax.tree.map(lambda x: (x * scale).astype(x.dtype), params)
+
+
+# ---- pool + allocator ---------------------------------------------------
+
+def test_page_allocator_reserves_null_page_and_never_partially_grants():
+    a = PageAllocator(8)            # pages 1..7 usable, 0 reserved
+    assert a.free_pages == 7
+    got = a.alloc(3)
+    assert got is not None and 0 not in got and len(set(got)) == 3
+    assert a.pages_in_use == 3
+    assert a.alloc(5) is None       # only 4 left: all-or-nothing
+    assert a.free_pages == 4        # the refused alloc took nothing
+    a.free(got)
+    assert a.free_pages == 7 and a.utilization == 0.0
+    with pytest.raises(ValueError):
+        a.free([0])                 # the null page is never allocatable
+    with pytest.raises(ValueError):
+        PageAllocator(1)
+
+
+def test_pool_shapes_and_int8_scales():
+    cfg = T.TINY_LM
+    pool = PagedKVPool(cfg, n_pages=5, page_size=4, kv_quant=True)
+    L = cfg.num_hidden_layers
+    assert len(pool.bufs.k) == L and len(pool.bufs.v) == L
+    assert pool.bufs.k[0].shape == (5, 4, cfg.num_key_value_heads,
+                                    cfg.resolved_head_dim)
+    assert pool.bufs.k[0].dtype == np.int8
+    # scales init to ONES so unwritten rows dequantize to exact zeros
+    # (matching init_cache) — zeros would make 0/0 garbage
+    assert float(pool.bufs.k_scale[0].max()) == 1.0
+    bf = PagedKVPool(cfg, n_pages=5, page_size=4)
+    assert bf.bufs.k_scale is None and bf.bufs.k[0].dtype == cfg.dtype
+
+
+# ---- shared accounting + capacity planner -------------------------------
+
+def test_decode_bench_imports_the_shared_accounting():
+    """Satellite: the roofline bench and the serving planner price steps
+    with ONE set of formulas (decode_bench re-exports, no private copy)."""
+    from scripts import decode_bench as db
+    assert db.kv_bytes_per_step is kv_bytes_per_step
+    from distributed_training_sandbox_tpu.serving import accounting
+    assert db.weight_read_bytes is accounting.weight_read_bytes
+
+
+def test_pool_capacity_planner_inverts_the_waterline():
+    cfg = T.TINY_LM
+    wb = 64 << 20
+    budget = 1.0
+    n = pool_capacity_pages(cfg, 8, budget_gb=budget, weight_bytes=wb)
+    assert n > 0
+    # the planned pool fits under the headroom-reduced budget...
+    assert serve_waterline_gb(cfg, n, 8, weight_bytes=wb) \
+        <= budget * 0.90 + 1e-9
+    # ...and one more page would not
+    assert serve_waterline_gb(cfg, n + 1, 8, weight_bytes=wb) \
+        > budget * 0.90 - page_bytes(cfg, 8) / (1024 ** 3)
+    # weights alone over budget -> refuse to serve
+    assert pool_capacity_pages(cfg, 8, budget_gb=0.01,
+                               weight_bytes=1 << 30) == 0
+    # tp shards the head axis: pages shrink, capacity grows
+    assert pool_capacity_pages(cfg, 8, budget_gb=budget, tp=2) \
+        >= 2 * pool_capacity_pages(cfg, 8, budget_gb=budget) - 1
+
+
+# ---- scheduler ----------------------------------------------------------
+
+def test_batcher_fcfs_admission_and_retire():
+    alloc = PageAllocator(8)        # 7 usable pages
+    cb = ContinuousBatcher(max_batch=2, allocator=alloc, page_size=8)
+    reqs = [Request(rid=i, prompt=np.arange(20, dtype=np.int32),
+                    max_new_tokens=12) for i in range(3)]    # 4 pages each
+    for r in reqs:
+        cb.submit(r, now=0.0)
+    admitted = cb.admit(now=0.0)
+    # slot free for rid 1 but only 3 pages left: head-of-line blocks
+    assert [r.rid for r in admitted] == [0]
+    assert reqs[1].state == "WAITING" and cb.slot_request(0) is reqs[0]
+    cb.retire(reqs[0], now=1.0)
+    assert cb.slot_request(0) is None and alloc.free_pages == 7
+    assert [r.rid for r in cb.admit(now=1.0)] == [1]
+    assert reqs[0].t_done == 1.0 and cb.completed_total == 1
+
+
+# ---- generate's pinned capacity knob ------------------------------------
+
+def test_generate_cache_capacity_validates_and_matches_default():
+    cfg = T.TINY_LM
+    params = _chaotic_params(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (1, 7), 1,
+                                cfg.vocab_size, dtype=np.int32)
+    with pytest.raises(ValueError, match="cache_capacity"):
+        generate(params, prompt, cfg, max_new_tokens=8, cache_capacity=10)
+    tight = np.asarray(generate(params, prompt, cfg, max_new_tokens=8))
+    wide = np.asarray(generate(params, prompt, cfg, max_new_tokens=8,
+                               cache_capacity=32))
+    # padding the cache past S0+new must not perturb the tokens (masked
+    # tail contributes exact zeros) — the property the paged view leans on
+    assert (tight == wide).all()
+
+
+# ---- THE acceptance: ragged continuous batching is bitwise --------------
+
+def test_ragged_batch_parity_and_zero_retraces():
+    """Mixed prompt lengths continuously batched — with admit/evict churn
+    (6 requests through 3 slots) — decode bitwise-identically to one-shot
+    generate per prompt, and the jit caches never grow after warmup."""
+    cfg = T.TINY_LM
+    params = _chaotic_params(cfg)
+    rng = np.random.default_rng(7)
+    lens = [4, 19, 11, 4, 27, 11]       # ragged, with repeats
+    prompts = [rng.integers(1, cfg.vocab_size, size=n).astype(np.int32)
+               for n in lens]
+    eng = ServingEngine(params, cfg, max_batch=3, page_size=8,
+                        max_seq_len=48, prefill_chunk=16, sync_every=4)
+    reqs = [eng.submit(p, max_new_tokens=10) for p in prompts]
+    eng.run()
+    for r in reqs:
+        ref = np.asarray(generate(
+            params, r.prompt[None], cfg, max_new_tokens=10,
+            cache_capacity=eng.view_capacity))[0]
+        got = np.asarray(r.tokens, np.int32)
+        assert got.shape == ref.shape and (got == ref).all(), \
+            f"rid {r.rid}: {got.tolist()} != {ref.tolist()}"
+    assert eng.retraces_after_warmup() == 0
+    slo = eng.slo_report()
+    assert slo["completed"] == 6
+    assert slo["ttft_ms"]["p50"] is not None
+    assert slo["per_token_ms"]["p99"] >= slo["per_token_ms"]["p50"]
+    assert 0 < slo["pool"]["peak_util"] <= 1.0
+
+
+def test_tp_sharded_engine_parity():
+    """Heads sharded over tp=2: same tokens, bitwise."""
+    from distributed_training_sandbox_tpu.utils import make_mesh
+    cfg = T.TINY_LM
+    params = _chaotic_params(cfg, seed=1)
+    mesh = make_mesh({"dp": len(jax.devices()) // 2, "tp": 2},
+                     register=False)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n).astype(np.int32)
+               for n in (5, 13)]
+    eng = ServingEngine(params, cfg, mesh=mesh, max_batch=2, page_size=8,
+                        max_seq_len=32, prefill_chunk=8)
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run()
+    for r in reqs:
+        ref = np.asarray(generate(
+            params, r.prompt[None], cfg, max_new_tokens=6,
+            cache_capacity=eng.view_capacity))[0]
+        assert (np.asarray(r.tokens, np.int32) == ref).all()
+    assert eng.retraces_after_warmup() == 0
+
+
+def test_disaggregated_prefill_decode_parity():
+    """Prefill and decode on separate device slices with the page-block
+    KV handoff in between: still bitwise."""
+    cfg = T.TINY_LM
+    params = _chaotic_params(cfg, seed=2)
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n).astype(np.int32)
+               for n in (6, 17)]
+    eng = ServingEngine(params, cfg, max_batch=2, page_size=8,
+                        max_seq_len=32, prefill_chunk=8, disaggregate=True)
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run()
+    for r in reqs:
+        ref = np.asarray(generate(
+            params, r.prompt[None], cfg, max_new_tokens=6,
+            cache_capacity=eng.view_capacity))[0]
+        assert (np.asarray(r.tokens, np.int32) == ref).all()
+    assert eng.slo_report()["disaggregated"] is True
+
+
+def test_kv_quant_pool_parity():
+    """int8 paged pool vs int8 one-shot cache: the same row quantizer on
+    the same rows -> bitwise-equal tokens."""
+    cfg = T.TINY_LM
+    params = _chaotic_params(cfg, seed=4)
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(1, cfg.vocab_size, size=n).astype(np.int32)
+               for n in (5, 12)]
+    eng = ServingEngine(params, cfg, max_batch=2, page_size=8,
+                        max_seq_len=32, prefill_chunk=8, kv_quant=True)
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run()
+    for r in reqs:
+        ref = np.asarray(generate(
+            params, r.prompt[None], cfg, max_new_tokens=6, kv_quant=True,
+            cache_capacity=eng.view_capacity))[0]
+        assert (np.asarray(r.tokens, np.int32) == ref).all()
+
+
+# ---- sharding contract --------------------------------------------------
+
+def test_serve_decode_contract_is_met_and_tight():
+    """The pinned serve_decode choreography: exactly 2 tp-psums per
+    (unrolled) layer, no other collective — lowered live on the mesh."""
+    from distributed_training_sandbox_tpu.analysis import check_counts
+    from distributed_training_sandbox_tpu.analysis.fixtures import (
+        build_strategy)
+    from distributed_training_sandbox_tpu.ops.hlo import count_collectives
+    b = build_strategy("serve_decode")
+    counts = count_collectives(b.step.lower(*b.args).as_text())
+    verdict = check_counts(b.contract, counts, b.ctx)
+    assert verdict.ok, verdict.summary()
+    tampered = dict(counts)
+    tampered["all_gather"] = tampered.get("all_gather", 0) + 1
+    assert not check_counts(b.contract, tampered, b.ctx).ok
+
+
+# ---- telemetry + SLO report wiring --------------------------------------
+
+def test_serving_telemetry_lands_in_summary_and_report(tmp_path):
+    from distributed_training_sandbox_tpu.telemetry import (
+        TelemetryRun, report as R)
+    from distributed_training_sandbox_tpu.telemetry.schema import (
+        validate_step)
+    cfg = T.TINY_LM
+    params = _chaotic_params(cfg, seed=5)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    with TelemetryRun("serving", results_dir=str(tmp_path),
+                      config={"num_steps": 0}) as telem:
+        eng = ServingEngine(params, cfg, max_batch=2, page_size=8,
+                            max_seq_len=32, telem=telem)
+        eng.submit(prompt, max_new_tokens=5)
+        eng.run()
+        telem.finalize(serving=eng.slo_report())
+    summ = json.load(open(f"{telem.run_dir}/summary.json"))
+    assert summ["serving"]["completed"] == 1
+    steps = R.load_steps(telem.run_dir)
+    assert any(ev.get("phase") == "prefill" and "ttft_ms" in ev
+               for ev in steps)
+    assert any(ev.get("phase") == "decode" for ev in steps)
+    for ev in steps:
+        assert validate_step(ev) == [], ev
+    rows = [R.run_row(rec) for rec in R.discover_runs([str(tmp_path)])]
+    assert rows and rows[0].get("serving")
+    table = R.render_serving(rows)
+    assert "TTFT" in table and "0 ✓" in table   # zero retraces cell
+
+
+# ---- end-to-end: the Poisson trace gate ---------------------------------
+
+def test_serve_bench_poisson_trace_completes_bitwise():
+    """Acceptance: a seeded 64-request Poisson trace (mixed lengths, the
+    open-loop driver) completes on the 8-way CPU mesh with zero
+    post-warmup retraces and spot-checked bitwise parity — exit 0 is the
+    script's own gate on both."""
+    from scripts.serve_bench import main
+    assert main(["--requests", "64", "--check-parity", "2"]) == 0
+
+
+def test_generate_demo_serve_smoke(tmp_path):
+    """Satellite: the demo's --serve mode pushes the tokenizer prompt
+    through the engine against a restored checkpoint and must match
+    one-shot greedy bitwise."""
+    from distributed_training_sandbox_tpu.utils import set_seed
+    from distributed_training_sandbox_tpu.utils.checkpoint import (
+        checkpoint_manager, save_state)
+    params = T.init_params(set_seed(42), T.TINY_LM)
+    mgr = checkpoint_manager(tmp_path / "ck")
+    save_state(mgr, 3, {"params": params}, wait=True)
+    from scripts.generate_demo import main
+    out = main(["--model", "tiny", "--ckpt-dir", str(tmp_path / "ck"),
+                "--max-new-tokens", "8", "--serve"])
+    assert out["serve_matches_greedy"] is True
+    assert out["serve_slo"]["completed"] == 1
+    assert out["samples"]["serve_greedy"] == out["samples"]["greedy"]
