@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -222,12 +223,106 @@ def measure(model_name: str, seq: int, batch: int, num_steps: int = 8,
     tok_s = batch * seq / dt
     ft = get_model_flops_per_token(cfg, seq)
     tflops_dev = tok_s * ft / ws / 1e12
-    return {
+    row = {
         "model": model_name, "seq_len": seq, "batch": batch,
         "devices": ws, "platform": jax.devices()[0].platform,
         "tokens_per_sec": round(tok_s, 1), "step_ms": round(dt * 1e3, 1),
         "tflops_per_device": round(tflops_dev, 2),
     }
+    led = _row_ledger(step, shards, opt, batch_arrs, mesh)
+    if led is not None:
+        row["ledger"] = led
+    return row
+
+
+def _row_ledger(step, shards, opt, batch_arrs, mesh) -> dict | None:
+    """Per-row collective ledger: a short profiled window AFTER the
+    timed loop (so timing is unaffected), joined against the row's own
+    compiled HLO.  ``BENCH_LEDGER=0`` opts out (e.g. when the extra AOT
+    compile is unwelcome on a big matrix); errors degrade to a tagged
+    record, never a failed row."""
+    if os.environ.get("BENCH_LEDGER", "1") == "0":
+        return None
+    import tempfile
+
+    import jax
+    from distributed_training_sandbox_tpu.telemetry.ledger import (
+        build_ledger)
+    from distributed_training_sandbox_tpu.utils.trace_analysis import (
+        collective_event_stats, latest_trace_file)
+    try:
+        hlo = step.lower(shards, opt, batch_arrs).compile().as_text()
+        with tempfile.TemporaryDirectory(prefix="bench-ledger-") as td:
+            with jax.profiler.trace(td):
+                for _ in range(2):
+                    shards, opt, loss = step(shards, opt, batch_arrs)
+                jax.block_until_ready(loss)
+            tf = latest_trace_file(td)
+            if tf is None:
+                return {"error": "no trace file written"}
+            led = build_ledger(collective_event_stats(tf), hlo,
+                               dict(mesh.shape))
+    except Exception as e:  # noqa: BLE001 - the ledger must not kill a row
+        return {"error": f"{type(e).__name__}: {e}"}
+    totals = led.totals()
+    # time-weighted busbw per collective kind (bus bytes over time,
+    # pooled across this row's sites)
+    by_kind: dict[str, dict] = {}
+    for e in led.entries:
+        k = by_kind.setdefault(e.kind, {"us": 0.0, "bus_bytes": 0.0})
+        factor = (e.busbw_gbps / e.algbw_gbps) if e.algbw_gbps else 1.0
+        k["us"] += e.total_us
+        k["bus_bytes"] += e.payload_bytes * e.occurrences * factor
+    return {
+        "busbw_gbps": totals["busbw_gbps"],
+        "busbw_by_kind": {
+            k: round(v["bus_bytes"] / v["us"] / 1e3, 4)
+            for k, v in sorted(by_kind.items()) if v["us"]},
+        "measured_sites": totals["measured_sites"],
+        "unmeasured_sites": totals["unmeasured_sites"],
+        "unmatched_events": totals["unmatched_events"],
+        "aggregates": led.aggregates(),
+    }
+
+
+def _gate_ledger_rows(rows: list[dict]) -> None:
+    """The bench-side bandwidth gate: when ``BENCH_LEDGER_BASELINE``
+    names a prior matrix JSON, diff each row's ledger aggregates against
+    the baseline row of the same config name
+    (``telemetry.ledger.check_bandwidth_regressions`` semantics,
+    ``BENCH_LEDGER_GATE_PCT`` max drop, default 20) and stamp
+    ``ledger["gate"]`` with ok / regressed / no_baseline."""
+    base_path = os.environ.get("BENCH_LEDGER_BASELINE")
+    max_drop = float(os.environ.get("BENCH_LEDGER_GATE_PCT", "20"))
+    base_by_cfg: dict[str, dict] = {}
+    if base_path and os.path.isfile(base_path):
+        try:
+            doc = json.load(open(base_path))
+            for r in (doc.get("matrix") or doc.get("rows") or []):
+                if isinstance(r, dict) and r.get("config") \
+                        and (r.get("ledger") or {}).get("aggregates"):
+                    base_by_cfg[r["config"]] = r["ledger"]["aggregates"]
+        except (OSError, json.JSONDecodeError):
+            pass
+    for r in rows:
+        led = r.get("ledger")
+        if not isinstance(led, dict) or not led.get("aggregates"):
+            continue
+        base = base_by_cfg.get(r.get("config"))
+        if not base:
+            led["gate"] = {"status": "no_baseline"}
+            continue
+        from distributed_training_sandbox_tpu.telemetry.ledger import (
+            check_bandwidth_regressions)
+        cmp_ = check_bandwidth_regressions(
+            led["aggregates"], base, max_drop_pct=max_drop,
+            label=r.get("config", ""), base_label=base_path)
+        bad = [c for c in cmp_ if c["regressed"]]
+        led["gate"] = {
+            "status": "regressed" if bad else "ok",
+            "max_drop_pct": max_drop,
+            "regressions": bad,
+        }
 
 
 def predict_row_gb(model_name: str, seq: int, batch: int,
@@ -303,6 +398,7 @@ def run_matrix(model_name: str, seq: int, base_batch: int):
         except Exception as e:  # noqa: BLE001 - every row must report
             rows.append(_failure_row(name, e, pred))
         print(f"[bench] {rows[-1]}", file=sys.stderr, flush=True)
+    _gate_ledger_rows(rows)
     return rows
 
 

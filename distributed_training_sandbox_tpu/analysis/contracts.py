@@ -350,6 +350,23 @@ CONTRACTS: dict[str, CollectiveContract] = {
 
 # ---------------------------------------------------------------- checking
 
+def parse_expected_spec(value) -> tuple[int, float]:
+    """One value of a serialized verdict's ``expected`` dict
+    (``ContractVerdict.to_dict``: int exact, ``"lo..hi"`` range,
+    ``"any"``/None unchecked) -> an inclusive ``(lo, hi)`` bound.  The
+    measured-side consumers (``telemetry.ledger``'s trace join) re-check
+    ranges from the manifest's already-serialized verdict, so the parse
+    lives next to the serializer."""
+    if value is None or value == "any":
+        return 0, math.inf
+    if isinstance(value, str) and ".." in value:
+        lo, hi = value.split("..", 1)
+        return int(lo), int(hi)
+    if isinstance(value, (tuple, list)):
+        return int(value[0]), int(value[1])
+    return int(value), int(value)
+
+
 @dataclass
 class ContractVerdict:
     """Outcome of checking observed counts against one contract."""

@@ -38,12 +38,20 @@ class BusResult:
         return asdict(self)
 
 
-def _bus_factor(name: str, n: int) -> float:
+def bus_factor(name: str, n: int) -> float:
+    """nccl-tests busbw/algbw wire factor for an ``n``-rank collective.
+    Shared with ``telemetry.ledger`` so measured trace bandwidths use the
+    exact same accounting as this microbenchmark."""
+    if n <= 1:
+        return 1.0
     if name == "all_reduce":
         return 2.0 * (n - 1) / n
     if name in ("all_gather", "reduce_scatter", "all_to_all"):
         return (n - 1) / n
-    return 1.0  # ppermute
+    return 1.0  # ppermute / collective_permute
+
+
+_bus_factor = bus_factor  # original (private) spelling
 
 
 def _build(name: str, mesh: Mesh, axis: str, nelems: int):

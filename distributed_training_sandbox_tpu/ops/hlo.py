@@ -83,7 +83,7 @@ _SHAPE_RE = re.compile(r"([a-z]+\d*(?:e\d+m\d+(?:fn)?)?)\[([\d,]*)\]")
 # matches: the char after the stem is "-", not "(" — same trick as
 # _PATTERNS).
 _INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?P<shape>\([^)]*\)|\S+)\s+"
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s*=\s*(?P<shape>\([^)]*\)|\S+)\s+"
     r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute|"
     r"all-to-all)(?P<start>-start)?\(")
 
@@ -142,6 +142,9 @@ class CollectiveInstance:
     replica_groups: tuple[tuple[int, ...], ...] | None = None
     is_async_start: bool = False
     line: str = field(default="", compare=False)
+    # instruction name ("all-reduce.1") — profiler trace events carry the
+    # same name, so this is the join key of telemetry.ledger
+    name: str = ""
 
 
 def collective_instances(text: str) -> list[CollectiveInstance]:
@@ -167,5 +170,6 @@ def collective_instances(text: str) -> list[CollectiveInstance]:
             kind=m.group("op").replace("-", "_"),
             shapes=tuple(shapes), dtypes=tuple(dtypes), bytes=nbytes,
             replica_groups=parse_replica_groups(raw),
-            is_async_start=bool(m.group("start")), line=raw.strip()))
+            is_async_start=bool(m.group("start")), line=raw.strip(),
+            name=m.group("name")))
     return out

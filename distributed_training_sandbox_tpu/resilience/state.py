@@ -192,6 +192,10 @@ class Checkpointer:
         self._mgr = None
         self._due = False
         self._saved_steps: set[int] = set()
+        # host-phase span stream (telemetry.spans.SpanStream): drivers
+        # with a TelemetryRun assign it so each save's blocking portion
+        # shows up as a checkpoint/save span on the merged timeline
+        self.spans = None
 
     @property
     def mgr(self):
@@ -269,9 +273,12 @@ class Checkpointer:
     def save(self, state: RunState, *, wait: bool = False) -> None:
         if state.step in self._saved_steps:
             return
+        from ..telemetry.spans import maybe_span
         state.lineage.setdefault("fingerprint", {}).update(self.fingerprint)
-        save_run_state(self.mgr, state, wait=wait,
-                       fingerprint=self.fingerprint)
+        with maybe_span(self.spans, "checkpoint/save", cat="checkpoint",
+                        step=int(state.step), wait=bool(wait)):
+            save_run_state(self.mgr, state, wait=wait,
+                           fingerprint=self.fingerprint)
         self._saved_steps.add(state.step)
         self._prune_meta()
 

@@ -65,10 +65,16 @@ class DevicePrefetcher(Iterator[Any]):
 
     def __init__(self, it: Iterable[Any], *, mesh=None, spec=None,
                  depth: int = 2,
-                 transform: Callable[[Any], Any] | None = None):
+                 transform: Callable[[Any], Any] | None = None,
+                 spans=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.depth = depth
+        # host-phase span stream (telemetry.spans.SpanStream) — drivers
+        # that build the prefetcher before the TelemetryRun assign it
+        # afterwards (``pref.spans = telem.spans``); records the
+        # consumer's queue waits and the producer thread's staging time
+        self.spans = spans
         self._it = iter(it)
         self._put = transform if transform is not None \
             else (lambda b: sharded_put(b, mesh, spec))
@@ -81,9 +87,12 @@ class DevicePrefetcher(Iterator[Any]):
 
     # ---- producer (background thread) -----------------------------------
     def _produce(self) -> None:
+        from ..telemetry.spans import maybe_span
         try:
             for item in self._it:
-                staged = self._put(item)
+                with maybe_span(self.spans, "prefetch/stage",
+                                cat="prefetch"):
+                    staged = self._put(item)
                 while not self._stop.is_set():
                     try:
                         self._q.put(staged, timeout=0.1)
@@ -111,7 +120,9 @@ class DevicePrefetcher(Iterator[Any]):
     def __next__(self) -> Any:
         if self._closed:
             raise StopIteration
-        item = self._q.get()
+        from ..telemetry.spans import maybe_span
+        with maybe_span(self.spans, "prefetch/wait", cat="prefetch"):
+            item = self._q.get()
         if isinstance(item, _End):
             self.close()
             raise StopIteration

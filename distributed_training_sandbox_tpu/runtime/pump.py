@@ -92,13 +92,20 @@ class StepPump:
     def _count(self, reason: str) -> None:
         self.sync_breakdown[reason] = self.sync_breakdown.get(reason, 0) + 1
 
-    def _block(self, arr, step: int | None = None) -> None:
-        """One blocking wait at a sync point, watchdog-guarded."""
+    def _block(self, arr, step: int | None = None,
+               reason: str = "sync") -> None:
+        """One blocking wait at a sync point, watchdog-guarded and
+        recorded as a ``pump/<reason>`` host span when the telemetry run
+        carries a span stream (the timeline evidence of where the host
+        actually stalls)."""
         import jax
-        if self.watchdog is not None:
-            self.watchdog.block(jax.block_until_ready, arr, step=step)
-        else:
-            jax.block_until_ready(arr)
+        from ..telemetry.spans import maybe_span
+        with maybe_span(getattr(self.telem, "spans", None),
+                        f"pump/{reason}", cat="pump", step=step):
+            if self.watchdog is not None:
+                self.watchdog.block(jax.block_until_ready, arr, step=step)
+            else:
+                jax.block_until_ready(arr)
 
     # ---- resolution ------------------------------------------------------
     def _resolve_one(self, idx: int, arr, log) -> float | None:
@@ -118,7 +125,8 @@ class StepPump:
         telemetry events that were deferred on them."""
         if not self._pending:
             return
-        self._block(self._pending[-1][1], step=self._pending[-1][0])
+        self._block(self._pending[-1][1], step=self._pending[-1][0],
+                    reason="drain")
         while self._pending:
             self._resolve_one(*self._pending.popleft())
         if self.telem is not None:
@@ -147,12 +155,13 @@ class StepPump:
                     and self.profiler.pending_transition())
         if self.mode == "sync" or boundary or (
                 self.sync_every and (i + 1) % self.sync_every == 0):
-            self._block(loss, step=i)
+            reason = ("per_step" if self.mode == "sync"
+                      else "profile_boundary" if boundary
+                      else "sync_every")
+            self._block(loss, step=i, reason=reason)
             self._drain()
             lf = self._resolve_one(i, loss, log)
-            self._count("per_step" if self.mode == "sync"
-                        else "profile_boundary" if boundary
-                        else "sync_every")
+            self._count(reason)
             if self.telem is not None:
                 self.telem.step(loss=lf, tokens=tokens,
                                 tracker_metrics=metrics, **extra)
@@ -166,7 +175,7 @@ class StepPump:
                                 tracker_metrics=metrics, **extra)
             if len(self._pending) > self.max_in_flight:
                 idx0, arr0, log0 = self._pending.popleft()
-                self._block(arr0, step=idx0)
+                self._block(arr0, step=idx0, reason="throttle")
                 self._resolve_one(idx0, arr0, log0)
                 if self.telem is not None:
                     self.telem.flush(up_to=1)

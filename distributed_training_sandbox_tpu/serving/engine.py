@@ -536,6 +536,11 @@ class ServingEngine:
         req.tokens.append(first)
         req.t_first = now
         prefill_s = time.perf_counter() - t_chunk
+        spans = getattr(self.telem, "spans", None)
+        if spans is not None:
+            spans.record("serve/prefill_chunk", start_perf=t_chunk,
+                         end_perf=time.perf_counter(), cat="serve",
+                         rid=req.rid, n_prompt=int(req.n_prompt))
         if self.telem is not None:
             self.telem.step(
                 loss=None, tokens=req.n_prompt,
@@ -569,6 +574,13 @@ class ServingEngine:
         act_d = self._put(self._h_active)
         pages_d = self._put(self._h_pages)
         bufs = self.pool.bufs
+        if self.telem is not None:
+            # ledger join (no-op unless the run owns an enabled
+            # profiler, and only compiles once): the decode program's
+            # text at this burst's exact arg shardings
+            self.telem.attach_step_hlo(self._decode, bufs, self._params,
+                                       pages_d, toks_d, len_d, stop_d,
+                                       act_d)
         t_burst = time.perf_counter()
         step_tokens = []
         for _ in range(sync):
@@ -585,6 +597,11 @@ class ServingEngine:
         mats = [np.asarray(t) for t in step_tokens]   # sync-ok
         self.stats["host_sync_count"] += 1
         burst_s = time.perf_counter() - t_burst
+        spans = getattr(self.telem, "spans", None)
+        if spans is not None:
+            spans.record("serve/decode_burst", start_perf=t_burst,
+                         end_perf=time.perf_counter(), cat="serve",
+                         steps=int(sync))
         t_book = time.perf_counter()
         active, lengths = A0.copy(), L0.copy()
         occ_burst, emitted = [], 0

@@ -10,17 +10,40 @@ Every training script emits the same three artifacts under
   * ``steps.jsonl``    — one event per optimizer step under the shared
     schema (:mod:`.schema`), fed by ``PerformanceTracker`` metrics;
   * ``summary.json``   — end-of-run aggregates plus, when profiling was
-    on, the ``trace_analysis.split_from_trace`` comm/compute split and
-    the trace directory.
+    on, the ``trace_analysis.split_from_trace`` comm/compute split of
+    the owned profiler session and the trace directory;
+  * ``spans.jsonl``    — host-side phase spans (:mod:`.spans`): prefetch
+    waits, pump sync barriers, checkpoint saves, serving bursts —
+    merged with the device trace by ``scripts/export_timeline.py``;
+  * ``collectives.json`` — the :mod:`.ledger` CollectiveLedger: per
+    compiled collective instruction, measured duration + payload bytes
+    + achieved algo/bus GB/s, joined against the strategy's
+    CollectiveContract (the measured verdict also lands in
+    ``manifest.json`` beside the static one).
 
 ``scripts/report.py`` reads these back for the cross-run side-by-side
 table and regression deltas — the ICI half of the NCCL-vs-ICI
 comparison in BASELINE.md.
 """
 
-from .schema import STEP_SCHEMA_VERSION, step_event  # noqa: F401
+from .schema import (  # noqa: F401
+    SPAN_SCHEMA_VERSION,
+    STEP_SCHEMA_VERSION,
+    span_event,
+    step_event,
+)
 from .manifest import RunManifest  # noqa: F401
 from .writer import MetricsWriter  # noqa: F401
+from .spans import SpanStream, maybe_span, read_spans  # noqa: F401
+from .ledger import (  # noqa: F401
+    CollectiveLedger,
+    LedgerEntry,
+    build_ledger,
+    check_bandwidth_regressions,
+    join_contract,
+    ledger_from_trace,
+    load_ledger_dict,
+)
 from .run import TelemetryRun  # noqa: F401
 from .report import (  # noqa: F401
     discover_runs,

@@ -1,12 +1,20 @@
-"""Run manifest: the immutable startup facts of one training run.
+"""Run manifest: the startup facts of one training run.
 
-Captured once before the step loop and never rewritten — everything a
-later reader needs to know *what* was run in order to trust the numbers
-in ``steps.jsonl``: strategy, the full ``TrainConfig``, mesh geometry,
-device kind/count, process topology, jax/jaxlib versions, git sha, and
-the compile-time HLO collective counts (``ops.hlo.count_collectives``)
-of the step function — the choreography fingerprint that lets the
-report CLI show "N all-reduces/step" next to step time.
+Captured once before the step loop — everything a later reader needs to
+know *what* was run in order to trust the numbers in ``steps.jsonl``:
+strategy, the full ``TrainConfig``, mesh geometry, device kind/count,
+process topology, jax/jaxlib versions, git sha, and the compile-time HLO
+collective counts (``ops.hlo.count_collectives``) of the step function —
+the choreography fingerprint that lets the report CLI show "N
+all-reduces/step" next to step time.
+
+The startup fields are immutable.  When the run owned a profiler,
+``TelemetryRun.finalize`` rewrites the file exactly once to append two
+measured-side fields: ``profile_sessions`` (the exact profiler session
+dirs this run created — trace ownership, so analysis never grabs a
+concurrent run's newer trace) and ``ledger`` (the trace-measured
+contract verdict from ``telemetry.ledger``, beside the static
+``contract`` verdict it mirrors).
 """
 
 from __future__ import annotations
@@ -69,6 +77,11 @@ class RunManifest:
     # prior segments' {run_id, start/end_step, status} records —
     # scripts/report.py stitches these into one segmented-run view
     lineage: dict | None = None
+    # appended at finalize when the run owned a profiler (see module
+    # docstring): session dirs this run's traces live in, and the
+    # measured collective-ledger verdict beside the static contract one
+    profile_sessions: list | None = None
+    ledger: dict | None = None
     extra: dict = field(default_factory=dict)
 
     @classmethod

@@ -149,6 +149,26 @@ def run_row(rec: dict) -> dict:
     # scripts/serve_bench.py) — rendered as its own section
     if summ.get("serving") is not None:
         row["serving"] = summ["serving"]
+    # collective ledger (telemetry.ledger): measured contract verdict +
+    # bus bandwidth from the compact manifest/summary block, per-(kind,
+    # payload, axis) aggregates from the run dir's collectives.json —
+    # the ICI side of the NCCL-vs-ICI table and the bandwidth gate
+    led = summ.get("ledger") or man.get("ledger") or {}
+    if led:
+        if "ok" in led:
+            row["ledger_ok"] = led.get("ok")
+        if led.get("busbw_gbps") is not None:
+            row["ledger_busbw_gbps"] = led.get("busbw_gbps")
+    from .ledger import load_ledger_dict
+    ld = load_ledger_dict(rec["dir"])
+    if ld:
+        row["ledger_aggregates"] = ld.get("aggregates") or {}
+        tot = ld.get("totals") or {}
+        if tot.get("busbw_gbps") is not None:
+            row.setdefault("ledger_busbw_gbps", tot["busbw_gbps"])
+        cj = ld.get("contract_join") or {}
+        if "ok" in cj:
+            row.setdefault("ledger_ok", cj["ok"])
     return row
 
 
@@ -249,6 +269,11 @@ def render_table(rows: list[dict]) -> str:
             cc_cell += " ✓"
         elif r.get("contract_ok") is False:
             cc_cell += " ✗"
+        # second mark: the trace-measured ledger verdict, when one ran
+        if r.get("ledger_ok") is True:
+            cc_cell += "⋈✓"
+        elif r.get("ledger_ok") is False:
+            cc_cell += "⋈✗"
         comm = r.get("comm_fraction")
         ovl = r.get("overlap_fraction")
         out.append(
@@ -469,6 +494,140 @@ def render_overlap_deltas(results: list[dict]) -> str:
             f"| {_fmt(r.get('step_time_ms'), '.2f')} "
             f"| {_fmt(r.get('baseline_step_time_ms'), '.2f')} "
             f"| {f'{sd:+.1%}' if sd is not None else '—'} "
+            f"| {'REGRESSED' if r['regressed'] else 'ok'} |")
+    return "\n".join(out)
+
+
+# ------------------------------------------------------- bus bandwidth
+
+# ledger kinds use count_collectives spelling; busbench / NCCL tables
+# call the permute "ppermute"
+_KIND_ALIASES = {"collective_permute": "ppermute"}
+
+
+def load_nccl_reference(path: str) -> list[dict]:
+    """Rows of ``baselines/nccl_reference.json``: one record per
+    (hardware, collective) with the reference busbw in GB/s.  Accepts the
+    dict form (``{"rows": [...]}``) or a bare list."""
+    try:
+        data = json.load(open(path))
+    except (OSError, json.JSONDecodeError):
+        return []
+    rows = data.get("rows") if isinstance(data, dict) else data
+    return [r for r in (rows or []) if isinstance(r, dict)]
+
+
+def load_roofline(path: str) -> list[dict]:
+    """Rows of a ``scripts/busbench.py`` sweep JSON (the measured
+    microbenchmark roofline).  Accepts the dict form (``{"platform",
+    "rows": [...]}``) or the legacy bare row list; a platform tag is
+    stamped onto each row when the file carries one."""
+    try:
+        data = json.load(open(path))
+    except (OSError, json.JSONDecodeError):
+        return []
+    if isinstance(data, dict):
+        rows = [r for r in (data.get("rows") or []) if isinstance(r, dict)]
+        plat = data.get("platform")
+        if plat:
+            for r in rows:
+                r.setdefault("platform", plat)
+        return rows
+    return [r for r in data if isinstance(r, dict)]
+
+
+def _best_busbw(rows: list[dict], kind: str) -> float | None:
+    """Peak busbw over a row set for one collective kind — the roofline
+    reading (best payload size wins)."""
+    name = _KIND_ALIASES.get(kind, kind)
+    vals = [r.get("busbw_gbps") for r in rows
+            if r.get("collective") in (name, kind)
+            and r.get("busbw_gbps") is not None]
+    return max(vals) if vals else None
+
+
+def render_bandwidth_table(rows: list[dict],
+                           nccl_rows: list[dict] | None = None,
+                           roofline_rows: list[dict] | None = None) -> str:
+    """The NCCL-vs-ICI side-by-side: every ledger aggregate (collective
+    kind × payload bucket × mesh axis) of every run that filed a
+    ``collectives.json``, beside the local busbench roofline (same
+    accounting, microbenchmark conditions) and the NCCL reference
+    hardware numbers."""
+    lrows = [r for r in rows if r.get("ledger_aggregates")]
+    if not lrows:
+        return "_no runs carry a collective ledger (profile-enabled " \
+               "runs with an attached HLO write collectives.json)_"
+    nccl_rows = nccl_rows or []
+    roofline_rows = roofline_rows or []
+    out = ["| run | collective | payload | axis | sites | events | "
+           "mean µs | busbw GB/s | roofline GB/s | NCCL ref GB/s |",
+           "|---|---|---|---|---|---|---|---|---|---|"]
+    for r in sorted(lrows, key=lambda r: r.get("run_id") or ""):
+        verdict = {True: " ⋈✓", False: " ⋈✗"}.get(r.get("ledger_ok"), "")
+        first = True
+        for key, a in sorted(r["ledger_aggregates"].items()):
+            kind = a.get("kind", key.split("|")[0])
+            roof = _best_busbw(roofline_rows, kind)
+            nccl = [f"{n.get('hardware', '?')} {n['busbw_gbps']:.0f}"
+                    for n in nccl_rows
+                    if n.get("collective") in (
+                        _KIND_ALIASES.get(kind, kind), kind)
+                    and n.get("busbw_gbps") is not None]
+            mean_us = (a["total_us"] / a["events"]) if a.get("events") \
+                else None
+            run_cell = (r.get("run_id", "—") + verdict) if first else "↳"
+            first = False
+            out.append(
+                f"| {run_cell} | {kind} | {a.get('payload_bucket', '—')} "
+                f"| {a.get('axis', '—')} | {_fmt(a.get('sites'), 'd')} "
+                f"| {_fmt(a.get('events'), 'd')} "
+                f"| {_fmt(mean_us, '.1f')} "
+                f"| {_fmt(a.get('busbw_gbps'), '.3f')} "
+                f"| {_fmt(roof, '.3f')} "
+                f"| {', '.join(nccl) if nccl else '—'} |")
+    return "\n".join(out)
+
+
+def check_bandwidth_regressions(current: list[dict], baseline: list[dict],
+                                max_drop_pct: float = 20.0) -> list[dict]:
+    """Bandwidth gate between comparable rows: for every (current,
+    baseline) pair :func:`_match` accepts where BOTH carry ledger
+    aggregates, diff each shared (kind, payload bucket, axis) key's
+    busbw via ``ledger.check_bandwidth_regressions`` — the CI gate
+    behind ``report.py --fail-on-bandwidth-regression``."""
+    from .ledger import check_bandwidth_regressions as _diff
+    results = []
+    for cur in current:
+        for base in baseline:
+            if cur is base or not _match(cur, base):
+                continue
+            ca, ba = cur.get("ledger_aggregates"), \
+                base.get("ledger_aggregates")
+            if not ca or not ba:
+                continue
+            results += _diff(ca, ba, max_drop_pct=max_drop_pct,
+                             label=cur.get("run_id"),
+                             base_label=base.get("run_id")
+                             or base.get("strategy"))
+    return results
+
+
+def render_bandwidth_regressions(results: list[dict]) -> str:
+    if not results:
+        return "_no comparable rows carry ledger aggregates (both sides " \
+               "need a collectives.json)_"
+    out = ["| run | baseline | collective\\|payload\\|axis | busbw GB/s | "
+           "base GB/s | Δ % | verdict |",
+           "|---|---|---|---|---|---|---|"]
+    for r in results:
+        key = r["key"].replace("|", "\\|")
+        out.append(
+            f"| {r['run_id']} | {r['baseline']} "
+            f"| {key} "
+            f"| {_fmt(r['busbw_gbps'], '.3f')} "
+            f"| {_fmt(r['baseline_busbw_gbps'], '.3f')} "
+            f"| {r['delta_pct']:+.1f} "
             f"| {'REGRESSED' if r['regressed'] else 'ok'} |")
     return "\n".join(out)
 

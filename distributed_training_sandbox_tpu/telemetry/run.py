@@ -11,9 +11,19 @@ Glues the pieces together around the step loop:
     still flushes the in-flight ``jax.profiler`` trace (the reference
     scripts only called ``prof.stop()`` on the happy path and lost the
     trace on crash);
+  * owns the host-phase ``SpanStream`` (``spans.jsonl``) the runtime
+    pieces (pump, prefetcher, checkpointer, serving engine) record
+    their wait/dispatch spans into;
   * writes ``summary.json`` at exit — aggregates plus, when profiling
     was on, the ``trace_analysis.split_from_trace`` comm/compute split
-    and the trace dir; a crash writes status="crashed" with the error.
+    of the profiler session this run *owns* (not "newest trace by
+    mtime" — a concurrent run must not be misattributed) and the trace
+    dir; a crash writes status="crashed" with the error;
+  * when the script also attached its compiled HLO (:meth:`attach_hlo`),
+    builds the :mod:`telemetry.ledger` CollectiveLedger from the owned
+    trace — per-collective payloads and bus-GB/s in
+    ``collectives.json``, with the measured contract verdict appended
+    to ``manifest.json`` beside the static one.
 
 Usage (the shape every scripts/ entrypoint now follows)::
 
@@ -86,6 +96,12 @@ class TelemetryRun:
         # set by StepPump.close(); lands in summary.json
         self.host_sync_count: int | None = None
         self.host_sync_breakdown: dict | None = None
+        # host-phase span stream (spans.jsonl), created at start();
+        # None when telemetry is off — call sites guard via maybe_span
+        self.spans = None
+        # compiled HLO of the step program (attach_hlo), joined against
+        # the owned trace at finalize to build the collective ledger
+        self._hlo_text: str | None = None
 
     @staticmethod
     def _unique_run_id(results_dir: str, strategy: str,
@@ -112,8 +128,37 @@ class TelemetryRun:
                 extra=self.extra)
             self.writer = MetricsWriter(self.run_dir)
             self.writer.write_manifest(self.manifest)
+            from .spans import SpanStream
+            self.spans = SpanStream(self.run_dir)
         self._t_prev = time.perf_counter()
         return self
+
+    def attach_hlo(self, compiled_text: str) -> None:
+        """Hand over the step program's ``compile().as_text()`` so
+        finalize can join the profiler trace against it (the collective
+        ledger needs instruction names + payload shapes).  Scripts call
+        this only when profiling is on — lowering+compiling purely for
+        the text would otherwise double compile cost."""
+        self._hlo_text = compiled_text
+
+    def attach_step_hlo(self, jitted, *args) -> None:
+        """Driver-facing form of :meth:`attach_hlo`: AOT-lower ``jitted``
+        at ``args`` and attach the compiled text.  ``args`` MUST be the
+        exact arrays the hot loop passes (same shapes, dtypes AND
+        shardings) — a differently-sharded example would compile a
+        different program whose instruction names don't match the traced
+        one, and the ledger join would report every site unmeasured.
+        No-op unless this run owns an *enabled* profiler (no trace, no
+        join — don't pay the extra compile); never raises."""
+        prof = self.profiler
+        if not self.enabled or self._hlo_text is not None \
+                or prof is None or not getattr(prof, "enabled", False):
+            return
+        try:
+            self.attach_hlo(jitted.lower(*args).compile().as_text())
+        except Exception as e:   # best-effort: telemetry must not crash
+            print(f"[telemetry] WARNING: could not attach compiled HLO "
+                  f"for the collective ledger: {type(e).__name__}: {e}")
 
     def __enter__(self) -> "TelemetryRun":
         return self.start()
@@ -249,14 +294,20 @@ class TelemetryRun:
             summary["host_sync_count"] = self.host_sync_count
             summary["host_sync_breakdown"] = self.host_sync_breakdown
         summary.update(extra)
-        # post-run profiling hook: comm/compute split from the trace the
-        # owned Profiler just flushed
+        # post-run profiling hook: comm/compute split + collective
+        # ledger from the trace session the owned Profiler just flushed
+        # (falling back to newest-under-trace_dir only when the profiler
+        # predates session ownership)
         prof = self.profiler
         if prof is not None and getattr(prof, "enabled", False):
             summary["trace_dir"] = prof.trace_dir
+            owned = list(getattr(prof, "owned_sessions", None) or [])
+            session = owned[-1] if owned else None
+            if owned:
+                summary["profile_sessions"] = owned
             try:
                 from ..utils.trace_analysis import split_from_trace
-                sp = split_from_trace(prof.trace_dir)
+                sp = split_from_trace(prof.trace_dir, session=session)
             except Exception:   # trace parsing must never fail the run
                 sp = None
             if sp is not None:
@@ -269,6 +320,54 @@ class TelemetryRun:
                     "overlap_fraction": sp.overlap_fraction,
                     "trace_file": sp.trace_file,
                 }
+            ledger_verdict = None
+            if self._hlo_text is not None:
+                try:
+                    ledger_verdict = self._build_ledger(session)
+                except Exception:   # ledger must never fail the run
+                    ledger_verdict = None
+            if ledger_verdict is not None:
+                summary["ledger"] = ledger_verdict
+            if self.manifest is not None and (owned or ledger_verdict):
+                # the one sanctioned manifest rewrite (see
+                # telemetry.manifest): append the measured-side facts
+                self.manifest.profile_sessions = owned or None
+                self.manifest.ledger = ledger_verdict
+                self.writer.write_manifest(self.manifest)
+        if self.spans is not None:
+            self.spans.close()
+            if self.spans.spans_written:
+                summary["spans_recorded"] = self.spans.spans_written
         self.writer.write_summary(summary)
         self.writer.close()
         return summary
+
+    def _build_ledger(self, session: str | None) -> dict | None:
+        """Build + file the collective ledger; returns the compact
+        verdict block that lands in summary/manifest, or None when no
+        trace was found."""
+        from .ledger import join_contract, ledger_from_trace
+        axis_sizes = dict(self.mesh.shape) if self.mesh is not None \
+            else dict((self.manifest.mesh_shape or {})
+                      if self.manifest else {})
+        led = ledger_from_trace(self.profiler.trace_dir, self._hlo_text,
+                                axis_sizes, session=session)
+        if led is None:
+            return None
+        join = None
+        if self.contract and isinstance(self.contract, dict) \
+                and self.contract.get("expected"):
+            join = join_contract(led, self.contract["expected"],
+                                 strategy=self.strategy)
+        self.writer.write_json("collectives.json", led.to_dict())
+        totals = led.totals()
+        out = {
+            "measured_sites": totals["measured_sites"],
+            "unmeasured_sites": totals["unmeasured_sites"],
+            "unmatched_events": totals["unmatched_events"],
+            "busbw_gbps": totals["busbw_gbps"],
+        }
+        if join is not None:
+            out["ok"] = join["ok"]
+            out["violations"] = join["violations"]
+        return out

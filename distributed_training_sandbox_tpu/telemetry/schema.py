@@ -84,6 +84,51 @@ def step_event(step: int, *, loss: float | None = None,
     return ev
 
 
+# ------------------------------------------------------------- host spans
+#
+# One JSONL line per host-side phase span (telemetry.spans.SpanStream):
+# where the host spent time *between* step events — prefetch waits, pump
+# sync barriers, checkpoint saves, serving bursts.  ``ts_us`` is
+# unix-epoch microseconds of span start; ``dur_us`` the span length.
+
+SPAN_SCHEMA_VERSION = 1
+
+SPAN_FIELDS = {
+    "schema": True,
+    "name": True,      # "pump/sync_every", "prefetch/wait", ...
+    "cat": False,      # coarse category: "pump" | "prefetch" | ...
+    "ts_us": True,
+    "dur_us": True,
+}
+
+
+def span_event(name: str, *, ts_us: float, dur_us: float,
+               cat: str | None = None, **attrs: Any) -> dict:
+    ev: dict[str, Any] = {
+        "schema": SPAN_SCHEMA_VERSION,
+        "name": str(name),
+        "cat": cat or str(name).split("/", 1)[0],
+        "ts_us": float(ts_us),
+        "dur_us": float(dur_us),
+    }
+    for k, v in attrs.items():
+        if v is not None:
+            ev.setdefault(k, v)
+    return ev
+
+
+def validate_span(ev: dict) -> list[str]:
+    problems = []
+    for field, required in SPAN_FIELDS.items():
+        if required and field not in ev:
+            problems.append(f"missing required span field {field!r}")
+    for field in ("ts_us", "dur_us"):
+        v = ev.get(field)
+        if v is not None and not isinstance(v, (int, float)):
+            problems.append(f"{field} must be numeric, got {v!r}")
+    return problems
+
+
 def validate_step(ev: dict) -> list[str]:
     """Schema-check one parsed event; returns a list of problems (empty
     when valid).  Used by tests and by ``report.py --strict``."""

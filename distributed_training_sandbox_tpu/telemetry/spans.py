@@ -1,0 +1,130 @@
+"""Host-side phase spans: the fourth telemetry artifact.
+
+``steps.jsonl`` says what each optimizer step cost; it cannot say *where
+the host spent the gaps* — blocked on the prefetch queue, barriered at a
+pump sync point, throttled on in-flight backpressure, inside an Orbax
+checkpoint write, or driving a serving prefill/decode burst.  Each of
+those sites records a :func:`maybe_span` here, appended to
+``spans.jsonl`` in the run dir, and ``scripts/export_timeline.py`` merges
+them with the device trace into one chrome-trace/Perfetto timeline.
+
+Schema (one JSON line per span, ``schema.span_event``):
+
+    {"schema": 1, "name": "pump/sync_every", "cat": "pump",
+     "ts_us": <unix-epoch µs of span start>, "dur_us": <float>, ...attrs}
+
+Timestamps are unix-epoch microseconds derived from a
+``perf_counter``-anchored clock captured at stream construction, so
+spans from different threads (the prefetcher's producer records from its
+own thread) share one monotonic timebase.  The stream is thread-safe and
+crash-tolerant: appends are flushed every :data:`FLUSH_EVERY` events and
+on ``close()``, which ``TelemetryRun.finalize`` reaches on every path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+from .schema import span_event
+
+FLUSH_EVERY = 32
+
+
+class SpanStream:
+    """Append-only ``spans.jsonl`` writer with a shared time anchor."""
+
+    FILENAME = "spans.jsonl"
+
+    def __init__(self, run_dir: str, flush_every: int = FLUSH_EVERY):
+        self.path = os.path.join(run_dir, self.FILENAME)
+        # one anchor pair: unix epoch at construction + the perf_counter
+        # reading at the same instant; every span timestamp is
+        # epoch + (perf_now - perf_anchor), monotonic across threads
+        self._epoch_us = time.time() * 1e6
+        self._perf_anchor = time.perf_counter()
+        self._lock = threading.Lock()
+        self._f = None
+        self._unflushed = 0
+        self.flush_every = max(int(flush_every), 1)
+        self.spans_written = 0
+        self._closed = False
+
+    def _now_us(self) -> float:
+        return self._epoch_us + (time.perf_counter()
+                                 - self._perf_anchor) * 1e6
+
+    def record(self, name: str, *, start_perf: float, end_perf: float,
+               cat: str | None = None, **attrs) -> None:
+        """File one completed span given its ``perf_counter`` bounds —
+        the form for call sites that already stopwatch themselves (the
+        serving engine's burst timers)."""
+        ts = self._epoch_us + (start_perf - self._perf_anchor) * 1e6
+        self._append(span_event(name, ts_us=ts,
+                                dur_us=(end_perf - start_perf) * 1e6,
+                                cat=cat, **attrs))
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str | None = None, **attrs):
+        """Context-manager form: times the body, files on exit (also on
+        exception — a crashed wait still shows in the timeline)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(name, start_perf=t0,
+                        end_perf=time.perf_counter(), cat=cat, **attrs)
+
+    # ---- file plumbing --------------------------------------------------
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            if self._f is None:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                self._f = open(self.path, "a")
+            self._f.write(json.dumps(ev, default=str) + "\n")
+            self.spans_written += 1
+            self._unflushed += 1
+            if self._unflushed >= self.flush_every:
+                self._f.flush()
+                self._unflushed = 0
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._f is not None:
+                self._f.flush()
+                self._f.close()
+                self._f = None
+
+
+@contextlib.contextmanager
+def maybe_span(stream, name: str, cat: str | None = None, **attrs):
+    """``stream.span(...)`` when a stream is wired, no-op when ``stream``
+    is None — the guard every runtime call site uses so spans never
+    impose a telemetry dependency."""
+    if stream is None:
+        yield
+        return
+    with stream.span(name, cat=cat, **attrs):
+        yield
+
+
+def read_spans(run_dir: str) -> list[dict]:
+    """Parse ``<run_dir>/spans.jsonl`` (missing file -> empty list)."""
+    path = os.path.join(run_dir, SpanStream.FILENAME)
+    out = []
+    if os.path.isfile(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+    return out

@@ -1,13 +1,19 @@
-"""Filesystem half of the telemetry layer: one run directory, three
+"""Filesystem half of the telemetry layer: one run directory, the
 artifacts.
 
 Layout contract (read back by ``telemetry.report`` / ``scripts/report.py``):
 
     <results_dir>/<run_id>/
-        manifest.json   written once at startup (RunManifest)
-        steps.jsonl     appended once per optimizer step (schema.step_event)
-        summary.json    written at finalize (and overwritten on crash
-                        with status="crashed" so partial runs are visible)
+        manifest.json     written at startup (RunManifest); rewritten
+                          once at finalize when profiling was on, to add
+                          the owned profiler sessions + ledger verdict
+        steps.jsonl       appended once per optimizer step (schema.step_event)
+        spans.jsonl       host-side phase spans (telemetry.spans), when any
+        collectives.json  the CollectiveLedger (telemetry.ledger), when
+                          profiling captured a trace and the run attached
+                          its compiled HLO
+        summary.json      written at finalize (and overwritten on crash
+                          with status="crashed" so partial runs are visible)
 
 The writer is deliberately dumb — no rank logic, no aggregation; the
 rank-0-only policy and the summary contents live in ``TelemetryRun``.
@@ -66,6 +72,15 @@ class MetricsWriter:
         if self._steps_f is not None and self._unflushed:
             self._steps_f.flush()
         self._unflushed = 0
+
+    def write_json(self, name: str, obj: dict) -> str:
+        """One auxiliary JSON artifact in the run dir (collectives.json
+        is the current client)."""
+        path = os.path.join(self.run_dir, name)
+        with open(path, "w") as f:
+            json.dump(obj, f, indent=2, default=str)
+            f.write("\n")
+        return path
 
     def write_summary(self, summary: dict) -> str:
         path = os.path.join(self.run_dir, self.SUMMARY)

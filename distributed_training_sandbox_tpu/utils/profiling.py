@@ -68,6 +68,21 @@ class Profiler:
         self.enabled = (jax.process_index() == 0) if enabled is None else enabled
         self._step = 0
         self._tracing = False
+        # session directories (plugins/profile/<ts>/) THIS profiler
+        # created, newest last — recorded by diffing the dir around each
+        # start/stop pair so trace analysis can target exactly the
+        # session it owns instead of "newest file anywhere by mtime"
+        self.owned_sessions: list[str] = []
+        self._pre_sessions: set[str] = set()
+
+    def _sessions(self) -> set[str]:
+        from .trace_analysis import profile_session_dirs
+        return set(profile_session_dirs(self.trace_dir))
+
+    def _record_owned(self) -> None:
+        new = sorted(self._sessions() - self._pre_sessions)
+        self.owned_sessions.extend(
+            s for s in new if s not in self.owned_sessions)
 
     def __enter__(self):
         return self
@@ -92,16 +107,19 @@ class Profiler:
         phase = self.schedule.phase(self._step)  # phase of the *next* step
         if phase == "trace" and not self._tracing:
             os.makedirs(self.trace_dir, exist_ok=True)
+            self._pre_sessions = self._sessions()
             jax.profiler.start_trace(self.trace_dir)
             self._tracing = True
         elif phase in ("wait", "done", "skip") and self._tracing:
             jax.profiler.stop_trace()
             self._tracing = False
+            self._record_owned()
 
     def stop(self) -> None:
         if self._tracing:
             jax.profiler.stop_trace()
             self._tracing = False
+            self._record_owned()
 
 
 @contextlib.contextmanager

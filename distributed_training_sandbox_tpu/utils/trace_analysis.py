@@ -86,9 +86,36 @@ class CommSplit:
                 f"comm overlapped with compute")
 
 
-def latest_trace_file(trace_dir: str) -> str | None:
-    files = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
-                      recursive=True)
+def profile_session_dirs(trace_dir: str) -> list[str]:
+    """The profiler session directories under ``trace_dir``
+    (``plugins/profile/<timestamp>/`` — one per start/stop_trace pair),
+    sorted by name (timestamps sort chronologically)."""
+    root = os.path.join(trace_dir, "plugins", "profile")
+    try:
+        return sorted(os.path.join(root, d) for d in os.listdir(root)
+                      if os.path.isdir(os.path.join(root, d)))
+    except OSError:
+        return []
+
+
+def latest_trace_file(trace_dir: str, session: str | None = None) \
+        -> str | None:
+    """Newest ``*.trace.json.gz`` under ``trace_dir`` — or, when
+    ``session`` names a profiler session directory (absolute, or relative
+    to ``trace_dir``), the trace inside exactly that session.  Passing
+    the owned session fixes the misattribution hazard of the bare-mtime
+    form: a concurrent run or a stale ``profiler_traces/`` entry can be
+    newer than the trace this run actually wrote."""
+    roots = [trace_dir]
+    if session:
+        sd = session if os.path.isabs(session) \
+            else os.path.join(trace_dir, session)
+        if os.path.isdir(sd):
+            roots = [sd]
+    files = []
+    for r in roots:
+        files += glob.glob(os.path.join(r, "**", "*.trace.json.gz"),
+                           recursive=True)
     return max(files, key=os.path.getmtime) if files else None
 
 
@@ -117,10 +144,13 @@ def interval_overlap_us(comm_iv: list, compute_iv: list) -> float:
     return total
 
 
-def split_from_trace(trace_dir: str, top_n: int = 5) -> CommSplit | None:
-    """Analyze the newest trace under ``trace_dir``.  Returns None when no
-    trace exists (profiling disabled / single uncaptured step)."""
-    tf = latest_trace_file(trace_dir)
+def split_from_trace(trace_dir: str, top_n: int = 5,
+                     session: str | None = None) -> CommSplit | None:
+    """Analyze the trace under ``trace_dir`` — the one in the owned
+    ``session`` directory when given (see :func:`latest_trace_file`),
+    else the newest.  Returns None when no trace exists (profiling
+    disabled / single uncaptured step)."""
+    tf = latest_trace_file(trace_dir, session=session)
     if tf is None:
         return None
     events = json.load(gzip.open(tf, "rt"))["traceEvents"]
@@ -161,6 +191,46 @@ def split_from_trace(trace_dir: str, top_n: int = 5) -> CommSplit | None:
         top_compute=top(compute),
         overlap_us=interval_overlap_us(comm_iv, compute_iv),
     )
+
+
+# -------------------------------------------- per-instance collectives
+#
+# Trace event names of device ops ARE compiled-HLO instruction names
+# ("all-reduce.1", "all-gather-start.3"), one event per participating
+# device row per invocation — verified on the CPU-sim backend against
+# compile().as_text() for every contract strategy.  This extracts the
+# per-instruction stats the CollectiveLedger (telemetry.ledger) joins
+# against ops.hlo.collective_instances.
+
+_COLLECTIVE_EVENT_RE = re.compile(
+    r"^(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(-start|-done)?(\.\d+)?$")
+
+
+def normalize_event_name(name: str) -> str:
+    """Trace event name -> HLO instruction name: strip a leading ``%``
+    and any ``scope/`` prefixes XLA may attach."""
+    return name.rsplit("/", 1)[-1].lstrip("%")
+
+
+def collective_event_stats(trace_file: str) -> dict[str, dict]:
+    """Per-instruction stats of every collective duration event in one
+    chrome-trace file: ``{instruction name: {"count", "total_us"}}``.
+    ``count`` sums across device rows (n_devices × invocations), so
+    ``total_us/count`` is the mean duration of one device's
+    participation — the number bandwidth math wants."""
+    events = json.load(gzip.open(trace_file, "rt"))["traceEvents"]
+    out: dict[str, dict] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = normalize_event_name(e.get("name", ""))
+        if not _COLLECTIVE_EVENT_RE.match(name):
+            continue
+        rec = out.setdefault(name, {"count": 0, "total_us": 0.0})
+        rec["count"] += 1
+        rec["total_us"] += float(e.get("dur", 0.0))
+    return out
 
 
 # --------------------------------------------------- HLO schedule shape

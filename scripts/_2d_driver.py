@@ -170,12 +170,17 @@ def _leg(mode, args, rest, cfg, ctx):
             contract=verdict.to_dict(),
             lineage=ctx.manifest_lineage(),
             extra={mode: second}) as telem:
+        pref.spans = telem.spans   # prefetch waits onto the timeline
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight) as pump:
             for i, batch in zip(range(ctx.start_step, cfg.num_steps), pref):
                 if ctx.should_stop(i):
                     break
+                if i == ctx.start_step:
+                    # ledger join: compiled text at the loop's exact
+                    # shardings (the staged batch, not a host copy)
+                    telem.attach_step_hlo(step, shards, opt_state, batch)
                 shards, opt_state, loss = step(shards, opt_state, batch)
                 log = (lambda lf, i=i:
                        print(f"[{name}] step {i:3d} loss {lf:.4f}")) \

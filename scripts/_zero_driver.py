@@ -57,6 +57,15 @@ def _time_steps(step_fn, state, batch, n_steps, telem=None, label="",
         if ctx is not None:
             ctx.finalize(telem)
         return (params, opt), losses, 0.0
+    if ctx is not None and getattr(ctx, "ckptr", None) is not None \
+            and telem is not None:
+        # checkpoint saves show up as checkpoint/save spans on the
+        # run's merged host timeline
+        ctx.ckptr.spans = telem.spans
+    if telem is not None:
+        # ledger join: compiled text at the loop's exact arg shardings
+        # (this driver reuses one fixed batch for every step)
+        telem.attach_step_hlo(step_fn, params, opt, batch)
     t0 = None
     pump = StepPump(telem=telem,
                     mode=cfg.dispatch if cfg else "async",

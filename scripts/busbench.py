@@ -141,8 +141,18 @@ def main(argv=None):
         # carry the caveat in the FILENAME so nobody mistakes a sim/1-chip
         # run for the ICI deliverable (VERDICT r2 #10)
         tag += "_harness_validation"
-    (out / f"{tag}.json").write_text(json.dumps(
-        [r.to_dict() for r in results], indent=2) + "\n")
+    # machine-readable sweep: the dict form scripts/report.py's roofline
+    # column and the bandwidth gate consume (telemetry.report.
+    # load_roofline also accepts the legacy bare-list form)
+    doc = {
+        "schema": 1,
+        "platform": platform,
+        "devices": n,
+        "payload_bytes": sorted({r.payload_bytes for r in results}),
+        "harness_validation": platform != "tpu" or n == 1,
+        "rows": [r.to_dict() for r in results],
+    }
+    (out / f"{tag}.json").write_text(json.dumps(doc, indent=2) + "\n")
     md = make_markdown(results, platform, n)
     (out / f"{tag}.md").write_text(md)
     print(f"[busbench] wrote {out / f'{tag}.json'} and {out / f'{tag}.md'}")

@@ -185,6 +185,7 @@ def _mlp_leg(args, cfg, ctx):
                             contract=verdict.to_dict(),
                             lineage=ctx.manifest_lineage(),
                             profiler=prof) as telem:
+        pref.spans = telem.spans   # prefetch waits onto the timeline
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight,
@@ -192,6 +193,10 @@ def _mlp_leg(args, cfg, ctx):
             for i, batch in zip(range(ctx.start_step, cfg.num_steps), pref):
                 if ctx.should_stop(i):
                     break
+                if i == ctx.start_step:
+                    # ledger join: compiled text at the loop's exact
+                    # shardings (the staged batch, not a host copy)
+                    telem.attach_step_hlo(step, params, opt_state, batch)
                 params, opt_state, loss = step(params, opt_state, batch)
                 log = (lambda lf, i=i:
                        print(f"[ddp] step {i:3d} loss {lf:.6f}")) \
@@ -336,6 +341,7 @@ def _classification_leg(args, cfg, ctx):
                             contract=verdict.to_dict(),
                             lineage=ctx.manifest_lineage(),
                             profiler=prof) as telem:
+        pref.spans = telem.spans   # prefetch waits onto the timeline
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight,
@@ -347,6 +353,7 @@ def _classification_leg(args, cfg, ctx):
                     sh = jbatch["input_ids"].sharding
                     assert getattr(sh, "spec", None) == P("dp"), \
                         f"batch not dp-sharded: {sh}"
+                    telem.attach_step_hlo(step, params, opt_state, jbatch)
                 params, opt_state, loss = step(params, opt_state, jbatch)
                 width = jbatch["input_ids"].shape[1]
                 log = (lambda lf, i=i, w=width:

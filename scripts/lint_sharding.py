@@ -91,6 +91,51 @@ def analyze_strategy(name: str, *, skip_recompile: bool = False,
     return report
 
 
+def check_ledger_run(run_dir: str) -> int:
+    """The ``--ledger`` CI mode: one run dir's static contract verdict
+    (``manifest.json:contract``, compile-time) against its measured twin
+    (``collectives.json:contract_join``, trace-joined).  The two verify
+    the same choreography from opposite directions — disagreement means
+    either the compiled program or the trace join drifted, and both
+    should gate."""
+    from distributed_training_sandbox_tpu.telemetry.ledger import (
+        load_ledger_dict)
+
+    man_path = Path(run_dir) / "manifest.json"
+    try:
+        manifest = json.load(open(man_path))
+    except (OSError, json.JSONDecodeError):
+        print(f"[lint:ledger] ERROR: cannot read {man_path}")
+        return 2
+    static = (manifest.get("contract") or {})
+    ledger = load_ledger_dict(run_dir)
+    if ledger is None:
+        print(f"[lint:ledger] ERROR: {run_dir} has no collectives.json "
+              f"(run with --profile and an attached HLO to produce one)")
+        return 2
+    join = ledger.get("contract_join") or {}
+    s_ok, m_ok = static.get("ok"), join.get("ok")
+    print(f"[lint:ledger] {run_dir}: static contract ok={s_ok}, "
+          f"measured contract_join ok={m_ok}")
+    for v in join.get("violations") or []:
+        print(f"[lint:ledger]   measured violation: {v}")
+    for v in static.get("violations") or []:
+        print(f"[lint:ledger]   static violation: {v}")
+    if s_ok is None or m_ok is None:
+        print("[lint:ledger] ERROR: verdict missing on one side "
+              "(static contract not recorded, or ledger built without "
+              "a contract)")
+        return 2
+    if bool(s_ok) != bool(m_ok):
+        print("[lint:ledger] FAIL: static and measured verdicts disagree")
+        return 1
+    if not m_ok:
+        print("[lint:ledger] FAIL: measured contract verdict not ok")
+        return 1
+    print("[lint:ledger] OK: measured verdict agrees with static")
+    return 0
+
+
 def main(argv=None) -> int:
     from distributed_training_sandbox_tpu.analysis.fixtures import STRATEGIES
 
@@ -114,7 +159,17 @@ def main(argv=None) -> int:
                    help="warnings also fail the run")
     p.add_argument("--json", dest="json_out", type=str, default=None,
                    help="write the JSON report here ('-' = stdout)")
+    p.add_argument("--ledger", type=str, default=None, metavar="RUN_DIR",
+                   help="measured-vs-static cross-check of one telemetry "
+                        "run dir: compare the manifest's static contract "
+                        "verdict with the trace-measured contract_join in "
+                        "its collectives.json; exit nonzero when they "
+                        "disagree or the measured side failed (skips the "
+                        "static analysis passes)")
     args = p.parse_args(argv)
+
+    if args.ledger:
+        return check_ledger_run(args.ledger)
 
     if args.cpu_devices:
         from distributed_training_sandbox_tpu.utils import use_cpu_devices

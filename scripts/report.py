@@ -21,6 +21,9 @@ Usage:
   python scripts/report.py runs --baseline base_runs \
       --fail-on-overlap-regression 5   # CI gate: overlap % may not drop
                                        # more than 5 pp vs baseline
+  python scripts/report.py runs --baseline base_runs \
+      --fail-on-bandwidth-regression 20  # CI gate: per-collective busbw
+                                         # may not drop more than 20 %
 """
 
 from __future__ import annotations
@@ -58,6 +61,19 @@ def main(argv=None) -> int:
                         "overlap %% (comm hidden behind compute) drops "
                         "more than PCT percentage points below its "
                         "baseline row — the overlap-engine CI gate")
+    p.add_argument("--fail-on-bandwidth-regression", type=float,
+                   default=None, metavar="PCT",
+                   help="with --baseline: exit nonzero when any ledger "
+                        "(collective, payload, axis) aggregate's busbw "
+                        "drops more than PCT %% below its baseline — "
+                        "the collective-ledger CI gate")
+    p.add_argument("--nccl-baseline", default=None, metavar="JSON",
+                   help="NCCL reference table for the side-by-side "
+                        "(default: baselines/nccl_reference.json when "
+                        "present)")
+    p.add_argument("--roofline", default=None, metavar="JSON",
+                   help="busbench sweep JSON for the roofline column "
+                        "(default: newest baselines/busbench_*.json)")
     p.add_argument("--steps", action="store_true",
                    help="also print the last 5 step events per run")
     p.add_argument("--strict", action="store_true",
@@ -87,8 +103,23 @@ def main(argv=None) -> int:
     if args.fail_on_overlap_regression is not None and not args.baseline:
         p.error("--fail-on-overlap-regression needs --baseline (the run "
                 "dir or summary to diff overlap %% against)")
+    if args.fail_on_bandwidth_regression is not None and not args.baseline:
+        p.error("--fail-on-bandwidth-regression needs --baseline (the "
+                "run dir whose collectives.json to diff against)")
 
-    comparisons, overlap_cmp = [], []
+    # reference tables for the NCCL-vs-ICI side-by-side: explicit paths
+    # win; otherwise the checked-in baselines/ artifacts when present
+    baselines_dir = Path(__file__).resolve().parent.parent / "baselines"
+    nccl_path = args.nccl_baseline or str(
+        baselines_dir / "nccl_reference.json")
+    nccl_rows = R.load_nccl_reference(nccl_path)
+    if args.roofline:
+        roofline_rows = R.load_roofline(args.roofline)
+    else:
+        cands = sorted(baselines_dir.glob("busbench_*.json"))
+        roofline_rows = R.load_roofline(str(cands[-1])) if cands else []
+
+    comparisons, overlap_cmp, bw_cmp = [], [], []
     if args.baseline:
         base_rows = R.load_baseline_rows(args.baseline)
         comparisons = R.check_regressions(rows, base_rows,
@@ -97,14 +128,22 @@ def main(argv=None) -> int:
             rows, base_rows,
             max_drop_pp=args.fail_on_overlap_regression
             if args.fail_on_overlap_regression is not None else 5.0)
+        bw_cmp = R.check_bandwidth_regressions(
+            rows, base_rows,
+            max_drop_pct=args.fail_on_bandwidth_regression
+            if args.fail_on_bandwidth_regression is not None else 20.0)
     regressed = [c for c in comparisons if c["regressed"]]
     overlap_regressed = ([c for c in overlap_cmp if c["regressed"]]
                          if args.fail_on_overlap_regression is not None
                          else [])
+    bw_regressed = ([c for c in bw_cmp if c["regressed"]]
+                    if args.fail_on_bandwidth_regression is not None
+                    else [])
 
     if args.as_json:
         print(json.dumps({"runs": rows, "comparisons": comparisons,
                           "overlap_comparisons": overlap_cmp,
+                          "bandwidth_comparisons": bw_cmp,
                           "schema_problems": schema_problems}, indent=2,
                          default=str))
     else:
@@ -117,6 +156,11 @@ def main(argv=None) -> int:
         if any(r.get("lineage") for r in rows):
             print("\n## Restart lineage (stitched segments)\n")
             print(R.render_lineage(rows))
+        if any(r.get("ledger_aggregates") for r in rows):
+            print("\n## Collective bus bandwidth (ledger vs roofline vs "
+                  "NCCL reference)\n")
+            print(R.render_bandwidth_table(rows, nccl_rows,
+                                           roofline_rows))
         if args.steps:
             for rec in recs:
                 tail = R.load_steps(rec["dir"])[-5:]
@@ -139,12 +183,19 @@ def main(argv=None) -> int:
                 print(f"\nOVERLAP REGRESSIONS: {len(overlap_regressed)} "
                       f"run(s) lost more than "
                       f"{args.fail_on_overlap_regression:g} pp of overlap")
+            if bw_cmp:
+                print(f"\n## Collective busbw deltas vs {args.baseline}\n")
+                print(R.render_bandwidth_regressions(bw_cmp))
+            if bw_regressed:
+                print(f"\nBANDWIDTH REGRESSIONS: {len(bw_regressed)} "
+                      f"ledger aggregate(s) dropped more than "
+                      f"{args.fail_on_bandwidth_regression:g} %")
         if schema_problems:
             print("\n## Schema violations\n")
             for prob in schema_problems:
                 print(f"* {prob}")
 
-    if regressed or schema_problems or overlap_regressed:
+    if regressed or schema_problems or overlap_regressed or bw_regressed:
         return 1
     return 0
 

@@ -78,6 +78,15 @@ def main(argv=None) -> int:
     p.add_argument("--check-parity", type=int, default=4, metavar="N",
                    help="verify the first N finished requests bitwise "
                         "against one-shot generate (0 disables)")
+    p.add_argument("--profile", action="store_true",
+                   help="own an XLA profiler session: comm/compute "
+                        "split + the decode collective ledger "
+                        "(collectives.json) land in the run dir")
+    p.add_argument("--trace-dir", default="profiler_traces")
+    p.add_argument("--export-timeline", action="store_true",
+                   help="after the run, merge spans.jsonl + the owned "
+                        "device trace into <run-dir>/timeline.json.gz "
+                        "(scripts/export_timeline.py)")
     p.add_argument("--param-scale", type=float, default=3.0,
                    help="scale random init weights — ~3 makes greedy "
                         "trajectories chaotic, so the parity check "
@@ -120,9 +129,18 @@ def main(argv=None) -> int:
                "page_size": args.page_size, "tp": args.tp,
                "kv_quant": args.kv_quant,
                "disaggregate": args.disaggregate}
+    prof = None
+    if args.profile:
+        from distributed_training_sandbox_tpu.utils.profiling import (
+            ProfileSchedule, Profiler)
+        # serving has no fixed step count; trace a window early enough
+        # to catch steady-state decode bursts
+        prof = Profiler(trace_dir=args.trace_dir,
+                        schedule=ProfileSchedule(skip_first=2, wait=1,
+                                                 warmup=2, active=8))
     failures = []
     with TelemetryRun("serving", model=args.model, mesh=mesh,
-                      config=run_cfg) as telem:
+                      config=run_cfg, profiler=prof) as telem:
         eng = ServingEngine(
             params, cfg, mesh=mesh, max_batch=args.max_batch,
             page_size=args.page_size, max_seq_len=args.max_seq_len,
@@ -168,6 +186,11 @@ def main(argv=None) -> int:
         slo["parity_checked"] = min(args.check_parity, len(reqs))
         slo["failures"] = failures
         telem.finalize(serving=slo)
+
+    if args.export_timeline and telem.run_dir:
+        sys.path.insert(0, str(Path(__file__).resolve().parent))
+        from export_timeline import main as export_main
+        export_main([telem.run_dir])
 
     print(json.dumps(slo, indent=1))
     for f in failures:
