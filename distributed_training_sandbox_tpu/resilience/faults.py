@@ -29,6 +29,16 @@ themselves become deterministic.  This module is that harness:
   * ``--inject-fault slow@N:ms`` — a straggler: sleep ``ms`` at step N.
     Must NOT trip the heartbeat monitor (its timeout bounds detection
     of *death*, not slowness);
+  * the serving-fleet kinds (checked per decode *burst*, not per
+    training step): ``kill_replica@N:k`` — replica ``k`` dies without
+    warning at its burst N (raises :class:`~.elastic.WorkerLost`; the
+    fleet re-enqueues its in-flight requests onto survivors);
+    ``hang_decode@N:k`` — wedge replica ``k``'s watchdog at burst N so
+    its next burst converts to a :class:`~.elastic.StepTimeoutError`;
+    ``slow_replica@N:ms`` — straggler burst: sleep ``ms`` at burst N;
+    ``corrupt_swap`` — no step: tear the hot-swap checkpoint before the
+    fleet restores it, pinning that a torn swap leaves the fleet
+    serving on the old weights;
   * :func:`truncate_checkpoint` / :func:`corrupt_checkpoint` — tamper
     with a saved step's files on disk, for pinning that a torn restore
     fails with a readable error instead of a tensorstore traceback.
@@ -46,11 +56,47 @@ import time
 from dataclasses import dataclass
 from pathlib import Path
 
-FAULT_KINDS = ("crash", "preempt", "kill_worker", "hang", "slow")
-#: kinds whose ``:target`` suffix is an integer (worker rank /
-#: milliseconds), not a leg label
-_INT_TARGET_KINDS = ("kill_worker", "slow")
-_SPEC_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<step>\d+)(?::(?P<target>[\w-]+))?$")
+
+@dataclass(frozen=True)
+class FaultKindInfo:
+    """One registered fault kind.  Everything derived from the spec
+    grammar — the valid-kind tuple, the integer-target rule, the parse
+    error message's examples — reads from :data:`FAULT_REGISTRY`, so a
+    new kind cannot drift out of sync with its validation."""
+    name: str
+    int_target: bool     # :target is an integer, not a leg label
+    target_what: str     # what the integer means, for error messages
+    step_required: bool  # "@STEP" mandatory (False: fires at a
+                         # context-defined moment, e.g. swap time)
+    example: str
+
+
+FAULT_REGISTRY: dict[str, FaultKindInfo] = {k.name: k for k in (
+    FaultKindInfo("crash", False, "", True, "crash@5"),
+    FaultKindInfo("preempt", False, "", True, "preempt@8:sharded"),
+    FaultKindInfo("kill_worker", True, "worker rank", True,
+                  "kill_worker@5:3"),
+    FaultKindInfo("hang", False, "", True, "hang@4"),
+    FaultKindInfo("slow", True, "milliseconds", True, "slow@3:50"),
+    FaultKindInfo("kill_replica", True, "replica index", True,
+                  "kill_replica@2:1"),
+    FaultKindInfo("hang_decode", True, "replica index", True,
+                  "hang_decode@2:0"),
+    FaultKindInfo("slow_replica", True, "milliseconds", True,
+                  "slow_replica@1:80"),
+    FaultKindInfo("corrupt_swap", False, "", False, "corrupt_swap"),
+)}
+
+FAULT_KINDS = tuple(FAULT_REGISTRY)
+#: kinds whose ``:target`` suffix is an integer (worker rank / replica
+#: index / milliseconds), not a leg label — derived, never hand-listed
+_INT_TARGET_KINDS = tuple(
+    k for k, info in FAULT_REGISTRY.items() if info.int_target)
+#: kinds consumed by the serving fleet (per-burst), not the train loop
+SERVING_FAULT_KINDS = (
+    "kill_replica", "hang_decode", "slow_replica", "corrupt_swap")
+_SPEC_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)(?:@(?P<step>\d+))?(?::(?P<target>[\w-]+))?$")
 
 
 class InjectedCrash(RuntimeError):
@@ -60,12 +106,14 @@ class InjectedCrash(RuntimeError):
 
 @dataclass(frozen=True)
 class FaultSpec:
-    kind: str            # "crash" | "preempt"
-    step: int            # loop index at which the fault fires
-    target: str = ""     # scope label ("" = any leg)
+    kind: str            # one of FAULT_KINDS (see FAULT_REGISTRY)
+    step: int            # loop step / decode burst at which it fires
+    target: str = ""     # scope label or int target ("" = any leg)
 
     def __str__(self) -> str:
-        base = f"{self.kind}@{self.step}"
+        base = self.kind
+        if FAULT_REGISTRY[self.kind].step_required:
+            base = f"{base}@{self.step}"
         return f"{base}:{self.target}" if self.target else base
 
 
@@ -76,19 +124,25 @@ def parse_fault_spec(spec: str | None) -> FaultSpec | None:
     if not spec:
         return None
     m = _SPEC_RE.match(spec.strip())
-    if not m or m.group("kind") not in FAULT_KINDS:
+    if not m or m.group("kind") not in FAULT_REGISTRY:
+        examples = ", ".join(
+            info.example for info in FAULT_REGISTRY.values())
         raise SystemExit(
             f"--inject-fault {spec!r} not understood: expected "
-            f"KIND@STEP[:leg] with KIND in {'/'.join(FAULT_KINDS)} "
-            f"(e.g. crash@5, preempt@8:sharded, kill_worker@5:3, "
-            f"hang@4, slow@3:50)")
+            f"KIND@STEP[:target] with KIND in {'/'.join(FAULT_KINDS)} "
+            f"(e.g. {examples})")
     kind, target = m.group("kind"), m.group("target") or ""
-    if kind in _INT_TARGET_KINDS and target and not target.isdigit():
-        what = "worker rank" if kind == "kill_worker" else "milliseconds"
+    info = FAULT_REGISTRY[kind]
+    if m.group("step") is None and info.step_required:
         raise SystemExit(
-            f"--inject-fault {spec!r}: {kind}'s :target is a {what} "
-            f"(an integer), got {target!r}")
-    return FaultSpec(kind=kind, step=int(m.group("step")), target=target)
+            f"--inject-fault {spec!r}: {kind} needs @STEP "
+            f"(e.g. {info.example})")
+    if info.int_target and target and not target.isdigit():
+        raise SystemExit(
+            f"--inject-fault {spec!r}: {kind}'s :target is a "
+            f"{info.target_what} (an integer), got {target!r}")
+    return FaultSpec(kind=kind, step=int(m.group("step") or 0),
+                     target=target)
 
 
 class FaultInjector:
@@ -109,6 +163,8 @@ class FaultInjector:
         ``hang`` wedges ``watchdog``; ``slow`` sleeps its target ms."""
         if self.fired or self.spec is None or step != self.spec.step:
             return
+        if self.spec.kind in SERVING_FAULT_KINDS:
+            return  # fleet-scoped: fired via check_serving / swap path
         if self.spec.kind in ("crash", "preempt") \
                 and self.spec.target and self.spec.target != scope:
             return
@@ -154,6 +210,54 @@ class FaultInjector:
         while shutdown is not None and not shutdown.requested \
                 and time.monotonic() < deadline:
             time.sleep(0.001)
+
+    def check_serving(self, replica: int, burst: int,
+                      watchdog=None) -> None:
+        """Serving-fleet twin of :meth:`check`, called at the top of
+        each replica's decode burst with that replica's own burst
+        counter.  ``kill_replica`` raises
+        :class:`~.elastic.WorkerLost` for the targeted replica (the
+        fleet's failover path consumes it); ``hang_decode`` wedges the
+        replica's watchdog so the burst's sync point converts to a
+        :class:`~.elastic.StepTimeoutError`; ``slow_replica`` sleeps
+        its target ms on whichever replica reaches burst N first.
+        ``corrupt_swap`` never fires here — the fleet consumes it at
+        swap time (see :meth:`wants_corrupt_swap`)."""
+        if self.fired or self.spec is None:
+            return
+        kind = self.spec.kind
+        if kind not in ("kill_replica", "hang_decode", "slow_replica"):
+            return
+        if burst != self.spec.step:
+            return
+        if kind in ("kill_replica", "hang_decode") \
+                and int(self.spec.target or "0") != replica:
+            return
+        self.fired = True
+        if kind == "slow_replica":
+            time.sleep(int(self.spec.target or "100") / 1000.0)
+            return
+        if kind == "hang_decode":
+            if watchdog is None:
+                raise SystemExit(
+                    f"--inject-fault hang_decode@{burst} needs a "
+                    f"decode watchdog — pass --watchdog-timeout "
+                    f"SECONDS > 0, otherwise the injected hang would "
+                    f"block forever")
+            watchdog.wedge()
+            return
+        from .elastic import WorkerLost
+        raise WorkerLost([replica], step=burst, trigger="kill_replica")
+
+    def wants_corrupt_swap(self) -> bool:
+        """True exactly once when the configured fault is
+        ``corrupt_swap`` — the fleet calls this at swap time and, if
+        true, tears the incoming checkpoint before restoring it."""
+        if self.fired or self.spec is None \
+                or self.spec.kind != "corrupt_swap":
+            return False
+        self.fired = True
+        return True
 
 
 # ---- checkpoint tampering (tests + manual debugging) ---------------------

@@ -5,9 +5,12 @@ The inference counterpart of the training ``runtime``: ``engine`` drives
 fixed-shape jitted decode steps over ``kv_pool``'s page blocks under
 ``scheduler``'s WAITING→PREFILL→DECODE→DONE state machine, and
 ``accounting`` holds the byte formulas shared with the decode roofline
-bench plus the pool capacity planner.  Entry points:
-:class:`ServingEngine` / :func:`serve` here, ``scripts/serve_bench.py``
-for the Poisson-traffic SLO report.
+bench plus the pool capacity planner.  ``fleet`` + ``router`` stack N
+engine replicas behind SLO-driven admission control with failover
+(deterministic request replay on survivors) and zero-drop weight
+hot-swap.  Entry points: :class:`ServingEngine` / :func:`serve` /
+:class:`Fleet` here, ``scripts/serve_bench.py`` for the
+Poisson-traffic SLO report (``--replicas N`` for the fleet).
 """
 
 from .accounting import (kv_bytes_per_step, page_bytes,
@@ -15,14 +18,18 @@ from .accounting import (kv_bytes_per_step, page_bytes,
                          weight_read_bytes)
 from .engine import (ServingEngine, make_serve_decode_step,
                      make_serve_prefill_step, serve)
+from .fleet import Fleet, Replica
 from .kv_pool import PageAllocator, PagedKVPool, PoolBuffers
-from .scheduler import ContinuousBatcher, Request
+from .router import AdmissionController, Rejection, Router
+from .scheduler import ContinuousBatcher, Request, reset_for_replay
 
 __all__ = [
     "ServingEngine", "serve", "make_serve_decode_step",
     "make_serve_prefill_step",
+    "Fleet", "Replica",
+    "AdmissionController", "Rejection", "Router",
     "PagedKVPool", "PageAllocator", "PoolBuffers",
-    "ContinuousBatcher", "Request",
+    "ContinuousBatcher", "Request", "reset_for_replay",
     "kv_bytes_per_step", "weight_read_bytes", "page_bytes",
     "serve_waterline_gb", "pool_capacity_pages",
 ]

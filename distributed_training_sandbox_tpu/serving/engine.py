@@ -328,7 +328,8 @@ class ServingEngine:
                  sync_every: int = 4, max_in_flight: int = 8,
                  kv_quant: bool = False,
                  hbm_budget_gb: float | None = None,
-                 disaggregate: bool = False, telem=None):
+                 disaggregate: bool = False, device=None,
+                 watchdog=None, telem=None):
         self.cfg = _decode_cfg(cfg)
         self.max_batch = int(max_batch)
         self.page_size = int(page_size)
@@ -345,9 +346,17 @@ class ServingEngine:
         self.tp_axis = tp_axis if mesh is not None else None
         self.telem = telem
         self.disaggregate = bool(disaggregate)
+        # collective watchdog (resilience.elastic.Watchdog): every
+        # blocking point in the decode path — the pump's sync sites and
+        # the burst's token resolution — routes through it, so a wedged
+        # burst becomes a StepTimeoutError the fleet's failover path
+        # can consume instead of a hung server
+        self.watchdog = watchdog
 
         tp = 1
         if mesh is not None:
+            if device is not None:
+                raise ValueError("pass mesh or device, not both")
             if disaggregate:
                 raise ValueError("disaggregate splits devices into "
                                  "single-program slices; pass mesh=None")
@@ -380,7 +389,18 @@ class ServingEngine:
 
         devs = jax.devices()
         self._prefill_dev = self._decode_dev = None
-        if self.disaggregate:
+        if device is not None:
+            # whole-engine device commitment: the fleet's per-replica
+            # slice, reusing the disaggregation device_put machinery
+            # with prefill and decode on the SAME device
+            if self.disaggregate:
+                raise ValueError("device commits the whole engine to "
+                                 "one device; disaggregate splits it — "
+                                 "pick one")
+            self._prefill_dev = self._decode_dev = device
+            self._params = self._params_pre = jax.device_put(params,
+                                                             device)
+        elif self.disaggregate:
             if len(devs) < 2:
                 raise ValueError("disaggregate needs >= 2 devices")
             self._prefill_dev = devs[0]
@@ -451,6 +471,8 @@ class ServingEngine:
         self._pending: list[Request] = []
         self.completed: list[Request] = []
         self._rid = 0
+        self._pump = None
+        self._t0: float | None = None
         self._warm_sizes = None
         self.stats = {"rounds": 0, "decode_steps": 0, "prefill_chunks": 0,
                       "admit_s": 0.0, "bookkeep_s": 0.0,
@@ -459,7 +481,7 @@ class ServingEngine:
 
     # ---- request intake ----------------------------------------------
     def submit(self, prompt, max_new_tokens: int,
-               arrival_s: float = 0.0) -> Request:
+               arrival_s: float | None = None) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1 or max_new_tokens < 1:
             raise ValueError("need >= 1 prompt token and >= 1 new token")
@@ -470,10 +492,36 @@ class ServingEngine:
                 f"(raise max_seq_len)")
         req = Request(rid=self._rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
-                      arrival_s=float(arrival_s))
+                      arrival_s=(None if arrival_s is None
+                                 else float(arrival_s)))
         self._rid += 1
         self._pending.append(req)
         return req
+
+    def enqueue(self, req: Request, now: float) -> None:
+        """Hand an externally-built request straight to the batcher —
+        the fleet router's dispatch path, where rids are fleet-global
+        and admission control already ran at submit."""
+        self.batcher.submit(req, now)
+
+    # ---- fleet queries -----------------------------------------------
+    def can_accept(self, req: Request) -> bool:
+        """True when ``req`` would be admitted at the next round: a
+        free slot AND its full page grant, with nothing already queued
+        (the fleet router keeps one global queue rather than stacking
+        head-of-line blocking inside every replica)."""
+        if self.batcher.waiting:
+            return False
+        if not any(r is None for r in self.batcher.slots):
+            return False
+        return (self.pool.allocator.free_pages
+                >= self.batcher.pages_needed(req))
+
+    def in_flight(self) -> int:
+        """Unfinished requests resident in this engine (queued or
+        holding a slot)."""
+        return len(self.batcher.waiting) + sum(
+            r is not None for r in self.batcher.slots)
 
     # ---- device-put helpers ------------------------------------------
     def _put(self, x, device=None):
@@ -593,8 +641,15 @@ class ServingEngine:
         self.stats["decode_steps"] += sync
         # sync point: the pump just resolved the last step's occupancy,
         # so the burst's token buffers are (near-)ready — resolve and
-        # replay the device's deterministic active chain on the host
-        mats = [np.asarray(t) for t in step_tokens]   # sync-ok
+        # replay the device's deterministic active chain on the host.
+        # Watchdog-guarded: a burst wedged here must surface as
+        # StepTimeoutError for the fleet's failover, never a silent hang
+        if self.watchdog is not None:
+            mats = self.watchdog.block(
+                lambda ts: [np.asarray(t) for t in ts],   # sync-ok
+                step_tokens, step=self.stats["decode_steps"])
+        else:
+            mats = [np.asarray(t) for t in step_tokens]   # sync-ok
         self.stats["host_sync_count"] += 1
         burst_s = time.perf_counter() - t_burst
         spans = getattr(self.telem, "spans", None)
@@ -644,62 +699,139 @@ class ServingEngine:
                      "tokens": len(r.tokens)} for r in finished])
 
     # ---- round loop ---------------------------------------------------
-    def run(self) -> list[Request]:
-        from ..runtime.pump import StepPump
+    def start(self, t0: float | None = None) -> None:
+        """Arm the engine clock and the persistent pump without driving
+        the loop.  ``run()`` calls it implicitly; the fleet calls it
+        explicitly with a SHARED ``t0`` so every replica's timestamps
+        live on one clock, then drives rounds via :meth:`step_round`."""
+        if self._t0 is None:
+            self._t0 = time.perf_counter() if t0 is None else t0
+        if self._pump is None:
+            from ..runtime.pump import StepPump
+            self._pump = StepPump(mode="async",
+                                  sync_every=self.sync_every,
+                                  max_in_flight=self.max_in_flight,
+                                  watchdog=self.watchdog)
 
-        pending = sorted(self._pending, key=lambda r: r.arrival_s)
+    def close_pump(self) -> None:
+        """Drain and drop the persistent pump (normal shutdown)."""
+        if self._pump is not None:
+            pump, self._pump = self._pump, None
+            pump.close()
+            self.stats["host_sync_count"] += pump.host_sync_count
+
+    def abandon_pump(self) -> None:
+        """Drop the pump WITHOUT draining — the failover path for a
+        dead/wedged replica whose in-flight work will never resolve
+        (draining would just re-raise the timeout or block)."""
+        self._pump = None
+
+    def step_round(self, now: float) -> list[Request]:
+        """One scheduler round at elapsed time ``now``: admit from the
+        waiting queue, run up to ``prefill_chunks_per_round`` prefill
+        chunks, one decode burst if any slot is active.  Returns the
+        requests that finished THIS round.  Faults surface here —
+        :class:`~..resilience.elastic.StepTimeoutError` propagates from
+        the burst's watchdog-guarded sync points."""
+        self.start()
+        t0 = self._t0
+        done_base = len(self.completed)
+        t_admit = time.perf_counter()
+        admitted = self.batcher.admit(now)
+        for req in admitted:
+            # install the slot's page-table row in the host
+            # mirror the decode burst ships (unused entries
+            # point at the null page)
+            self._h_pages[req.slot] = 0
+            self._h_pages[req.slot, :len(req.pages)] = req.pages
+            if self.disaggregate:
+                n = -(-req.n_prompt // self.page_size)
+                pre = self.pool_pre.allocator.alloc(n)
+                if pre is None:
+                    raise RuntimeError(
+                        "prefill pool exhausted — it is sized "
+                        "like the decode pool, so this is a "
+                        "leak, not load")
+                self._pre_pages[req.rid] = pre
+        self.stats["admit_s"] += time.perf_counter() - t_admit
+        for _ in range(self.prefill_chunks_per_round):
+            req = self.batcher.next_prefill()
+            if req is None:
+                break
+            self._prefill_one_chunk(req, t0)
+        if self._h_active.any():
+            self._decode_burst(self._pump, t0)
+        self.stats["rounds"] += 1
+        self.stats["occupancy_sum"] += int(self._h_active.sum())
+        self.stats["peak_pool_util"] = max(
+            self.stats["peak_pool_util"], self.pool.utilization)
+        if self._warm_sizes is None \
+                and self.stats["decode_steps"] > 0:
+            self._warm_sizes = self._jit_sizes()
+        return self.completed[done_base:]
+
+    def run(self) -> list[Request]:
+        def vt(r):
+            return r.arrival_s if r.arrival_s is not None else 0.0
+
+        pending = sorted(self._pending, key=vt)
         self._pending = []
-        t0 = time.perf_counter()
-        pump = StepPump(mode="async", sync_every=self.sync_every,
-                        max_in_flight=self.max_in_flight)
+        self.start()
+        t0 = self._t0
         newly_done_base = len(self.completed)
         try:
             while pending or self.batcher.has_work():
                 now = time.perf_counter() - t0
-                while pending and pending[0].arrival_s <= now:
+                while pending and vt(pending[0]) <= now:
                     self.batcher.submit(pending.pop(0), now)
                 if not self.batcher.has_work():
                     # idle until the next virtual arrival
-                    time.sleep(min(max(pending[0].arrival_s - now, 0.0),
+                    time.sleep(min(max(vt(pending[0]) - now, 0.0),
                                    0.05))
                     continue
-                t_admit = time.perf_counter()
-                admitted = self.batcher.admit(now)
-                for req in admitted:
-                    # install the slot's page-table row in the host
-                    # mirror the decode burst ships (unused entries
-                    # point at the null page)
-                    self._h_pages[req.slot] = 0
-                    self._h_pages[req.slot, :len(req.pages)] = req.pages
-                    if self.disaggregate:
-                        n = -(-req.n_prompt // self.page_size)
-                        pre = self.pool_pre.allocator.alloc(n)
-                        if pre is None:
-                            raise RuntimeError(
-                                "prefill pool exhausted — it is sized "
-                                "like the decode pool, so this is a "
-                                "leak, not load")
-                        self._pre_pages[req.rid] = pre
-                self.stats["admit_s"] += time.perf_counter() - t_admit
-                for _ in range(self.prefill_chunks_per_round):
-                    req = self.batcher.next_prefill()
-                    if req is None:
-                        break
-                    self._prefill_one_chunk(req, t0)
-                if self._h_active.any():
-                    self._decode_burst(pump, t0)
-                self.stats["rounds"] += 1
-                self.stats["occupancy_sum"] += int(self._h_active.sum())
-                self.stats["peak_pool_util"] = max(
-                    self.stats["peak_pool_util"], self.pool.utilization)
-                if self._warm_sizes is None \
-                        and self.stats["decode_steps"] > 0:
-                    self._warm_sizes = self._jit_sizes()
+                self.step_round(now)
         finally:
-            pump.close()
-            self.stats["host_sync_count"] += pump.host_sync_count
+            self.close_pump()
         self.stats["wall_s"] += time.perf_counter() - t0
         return self.completed[newly_done_base:]
+
+    # ---- failover / hot-swap -----------------------------------------
+    def release_all(self) -> list[Request]:
+        """Failover teardown: every unfinished request leaves reset for
+        replay (see ``scheduler.reset_for_replay``), slots and pages are
+        freed, the host mirrors zeroed.  The device pool is NOT touched
+        — a dead replica's buffers die with it."""
+        orphans = self.batcher.release_all()
+        if self.disaggregate:
+            for rid in list(self._pre_pages):
+                self.pool_pre.allocator.free(self._pre_pages.pop(rid))
+        self._h_active[:] = False
+        self._h_pages[:] = 0
+        return orphans
+
+    def swap_params(self, params) -> None:
+        """Install new weights on a DRAINED engine — the fleet's
+        hot-swap lands here once the replica has zero requests in
+        flight.  Placement mirrors ``__init__`` (tp shard / device
+        commit), and the new tree must match the old one's
+        shapes/dtypes, so the jitted steps see identical avals and the
+        zero-retrace contract survives the swap."""
+        if self.batcher.has_work():
+            raise RuntimeError(
+                f"swap_params with {self.in_flight()} request(s) in "
+                f"flight — drain the replica first (the fleet's swap "
+                f"path does this at a burst boundary)")
+        if self.mesh is not None:
+            from ..parallel.tensor import shard_params_tp
+            params = shard_params_tp(params, self.mesh, self.tp_axis)
+            self._params = self._params_pre = params
+        elif self._decode_dev is not None:
+            self._params = jax.device_put(params, self._decode_dev)
+            self._params_pre = (
+                self._params if self._prefill_dev is self._decode_dev
+                else jax.device_put(params, self._prefill_dev))
+        else:
+            self._params = self._params_pre = params
 
     def _jit_sizes(self) -> dict:
         from ..analysis.recompile import jit_cache_size

@@ -1,0 +1,174 @@
+"""SLO-driven admission control + load-aware dispatch for the fleet.
+
+Two pieces, both pure host bookkeeping:
+
+:class:`AdmissionController` — deadline-aware load shedding decided AT
+SUBMIT TIME on the trace's *virtual* clock.  The model is deliberately
+the simple one the ISSUE names: modeled TTFT = (modeled queue wait + 1
+service round) where the wait is ``queue_depth_beyond_capacity ×
+per-burst latency``.  A request is rejected with a structured
+:class:`Rejection` when the bounded queue is full (``queue_full``) or
+when the modeled TTFT exceeds its deadline (``deadline``) — instead of
+admitting it into a tail blowup it can only lose.  Because every
+decision is a pure function of (virtual arrival order, arrival times,
+max_new, the burst-latency prior), the shed set is REPRODUCIBLE from
+the traffic seed — the determinism the overload test pins.  Measured
+per-burst latency feeds back via :meth:`observe_burst` (EWMA), which
+only affects offers made *after* the observation; open-loop drivers
+that submit the whole trace up front therefore shed identically on
+every run.
+
+:class:`Router` — one fleet-global FCFS dispatch queue in front of the
+replicas (head-of-line blocking stays HERE, not stacked inside every
+engine), least-loaded dispatch among live replicas that can actually
+seat the request (free slot + full page grant), and
+:meth:`requeue_front` for failover: a dead replica's replayed requests
+go back to the queue HEAD in their original order, so survivors pick
+them up before newer traffic.
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import deque
+from dataclasses import dataclass
+
+from .scheduler import Request
+
+__all__ = ["AdmissionController", "Rejection", "Router"]
+
+
+@dataclass(frozen=True)
+class Rejection:
+    """Structured load-shed record — what the client gets instead of a
+    silent tail blowup, and what the fleet report renders."""
+    rid: int
+    reason: str                    # "queue_full" | "deadline"
+    t_s: float                     # virtual arrival of the decision
+    modeled_ttft_ms: float
+    deadline_ms: float | None
+    queue_depth: int
+
+    def as_dict(self) -> dict:
+        return {"rid": self.rid, "reason": self.reason,
+                "t_s": round(self.t_s, 4),
+                "modeled_ttft_ms": round(self.modeled_ttft_ms, 3),
+                "deadline_ms": (None if self.deadline_ms is None
+                                else round(self.deadline_ms, 3)),
+                "queue_depth": self.queue_depth}
+
+
+class AdmissionController:
+    """Virtual-time occupancy model + shed policy (module docstring).
+
+    ``total_slots``: fleet-wide concurrent capacity (replicas ×
+    max_batch) — arrivals beyond it are modeled as waiting.
+    ``max_queue``: bound on the modeled waiting line; deeper arrivals
+    are shed ``queue_full``.  ``burst_s``: the per-burst latency prior;
+    ``steps_per_burst``: tokens a request earns per burst (the engine's
+    ``sync_every``), used to model service time.  ``calibrate=False``
+    freezes the prior (fully deterministic even for closed-loop
+    drivers)."""
+
+    def __init__(self, total_slots: int, *, max_queue: int = 8,
+                 burst_s: float = 0.05, steps_per_burst: int = 4,
+                 calibrate: bool = True):
+        self.total_slots = max(int(total_slots), 1)
+        self.max_queue = max(int(max_queue), 0)
+        self.burst_s = float(burst_s)
+        self.steps_per_burst = max(int(steps_per_burst), 1)
+        self.calibrate = bool(calibrate)
+        #: heap of modeled completion times of admitted requests
+        self._backlog: list[float] = []
+        self.offered_total = 0
+        self.shed_total = 0
+
+    # ---- the submit-time decision ------------------------------------
+    def offer(self, arrival_s: float, max_new_tokens: int,
+              deadline_s: float | None = None
+              ) -> tuple[str | None, float, int]:
+        """Decide one arrival: returns ``(reason, modeled_ttft_s,
+        queue_depth)`` with reason None on admit.  Admitting pushes the
+        request's modeled completion into the backlog, so later offers
+        see it occupying capacity until then."""
+        self.offered_total += 1
+        while self._backlog and self._backlog[0] <= arrival_s:
+            heapq.heappop(self._backlog)
+        depth = len(self._backlog)
+        waiting = max(0, depth - self.total_slots)
+        modeled_ttft = (waiting + 1) * self.burst_s
+        if waiting >= self.max_queue:
+            self.shed_total += 1
+            return "queue_full", modeled_ttft, depth
+        if deadline_s is not None and modeled_ttft > deadline_s:
+            self.shed_total += 1
+            return "deadline", modeled_ttft, depth
+        service = self.burst_s * (
+            -(-int(max_new_tokens) // self.steps_per_burst))
+        heapq.heappush(self._backlog,
+                       arrival_s + modeled_ttft + service)
+        return None, modeled_ttft, depth
+
+    def observe_burst(self, burst_s: float) -> None:
+        """EWMA-calibrate the prior from a measured burst.  Only offers
+        made AFTER this call see the update — submit-up-front drivers
+        keep a bit-stable shed set."""
+        if self.calibrate and burst_s > 0:
+            self.burst_s = 0.8 * self.burst_s + 0.2 * float(burst_s)
+
+
+class Router:
+    """Fleet-global dispatch queue + structured rejections."""
+
+    def __init__(self, admission: AdmissionController):
+        self.admission = admission
+        self.queue: deque[Request] = deque()
+        self.rejections: list[Rejection] = []
+        self.dispatched_total = 0
+
+    def submit(self, req: Request,
+               deadline_s: float | None = None) -> Rejection | None:
+        """Admission decision for one request at its (virtual) arrival.
+        Returns the Rejection when shed (the request never enters the
+        system), None when admitted — the caller then feeds it to
+        :meth:`enqueue` once its arrival time is due."""
+        arrival = req.arrival_s if req.arrival_s is not None else 0.0
+        reason, ttft_s, depth = self.admission.offer(
+            arrival, req.max_new_tokens, deadline_s)
+        if reason is None:
+            return None
+        rej = Rejection(
+            rid=req.rid, reason=reason, t_s=arrival,
+            modeled_ttft_ms=1e3 * ttft_s,
+            deadline_ms=None if deadline_s is None else 1e3 * deadline_s,
+            queue_depth=depth)
+        self.rejections.append(rej)
+        return rej
+
+    def enqueue(self, req: Request) -> None:
+        self.queue.append(req)
+
+    def requeue_front(self, reqs: list[Request]) -> None:
+        """Failover: replayed requests re-enter at the queue HEAD in
+        their original order — survivors serve them before new work."""
+        self.queue.extendleft(reversed(reqs))
+
+    def dispatch(self, replicas, now: float) -> list[tuple[object, Request]]:
+        """Drain the queue head onto the least-loaded LIVE replica that
+        can seat it (free slot + full page grant).  FCFS: a head that
+        no replica can seat blocks the queue — deliberate, matching the
+        engines' own no-starvation policy."""
+        sent = []
+        while self.queue:
+            req = self.queue[0]
+            cands = [r for r in replicas
+                     if r.state == "live" and r.engine.can_accept(req)]
+            if not cands:
+                break
+            rep = min(cands,
+                      key=lambda r: (r.engine.in_flight(), r.idx))
+            self.queue.popleft()
+            rep.engine.enqueue(req, now)
+            self.dispatched_total += 1
+            sent.append((rep, req))
+        return sent

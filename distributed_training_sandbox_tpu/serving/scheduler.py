@@ -48,7 +48,10 @@ class Request:
     rid: int
     prompt: np.ndarray          # (S0,) int32
     max_new_tokens: int
-    arrival_s: float = 0.0
+    #: virtual arrival time; None = "now" at submit.  An explicit None
+    #: sentinel, NOT falsy-0.0 — the first request of every virtual
+    #: trace legitimately arrives at 0.0 and must keep that timestamp.
+    arrival_s: float | None = None
 
     state: str = WAITING
     slot: int | None = None
@@ -116,7 +119,9 @@ class ContinuousBatcher:
     # ---- transitions --------------------------------------------------
     def submit(self, req: Request, now: float) -> None:
         req.state = WAITING
-        req.t_submit = req.arrival_s if req.arrival_s else now
+        # explicit None check: arrival_s == 0.0 is a real timestamp
+        # (the head of every virtual trace), not "unset"
+        req.t_submit = req.arrival_s if req.arrival_s is not None else now
         self.waiting.append(req)
 
     def admit(self, now: float) -> list[Request]:
@@ -146,8 +151,14 @@ class ContinuousBatcher:
     def retire(self, req: Request, now: float) -> None:
         """DONE: release the slot and every page (eviction between
         decode bursts — the device never sees it, only the next burst's
-        rewritten host arrays do)."""
-        assert req.slot is not None and self.slots[req.slot] is req
+        rewritten host arrays do).  Double-retire (or retiring a
+        request this batcher never admitted) is a real failover-churn
+        hazard — rejected loudly, never a silent double-free."""
+        if req.slot is None or self.slots[req.slot] is not req:
+            raise ValueError(
+                f"retire(rid={req.rid}): request is not resident in "
+                f"this batcher (slot={req.slot}, state={req.state}) — "
+                f"double retire or foreign request")
         self.slots[req.slot] = None
         self.allocator.free(req.pages)
         req.pages = None
@@ -155,3 +166,42 @@ class ContinuousBatcher:
         req.state = DONE
         req.t_done = now
         self.completed_total += 1
+
+    def release_all(self) -> list[Request]:
+        """Failover teardown: free every resident request's slot and
+        pages and drain the waiting queue, returning all unfinished
+        requests (resident first, in slot order, then waiting FCFS) so
+        the fleet can replay them on a survivor.  Counters are NOT
+        rewound — the survivor's ``admitted_total`` will count the
+        re-admission, and the fleet aggregates by rid."""
+        orphans: list[Request] = []
+        for b, req in enumerate(self.slots):
+            if req is None:
+                continue
+            self.slots[b] = None
+            self.allocator.free(req.pages)
+            reset_for_replay(req)
+            orphans.append(req)
+        while self.waiting:
+            req = self.waiting.popleft()
+            reset_for_replay(req)
+            orphans.append(req)
+        return orphans
+
+
+def reset_for_replay(req: Request) -> None:
+    """Rewind a request to its just-submitted state so a survivor
+    replica can replay it from scratch.  Greedy decode is deterministic
+    in (params, prompt), so a full replay reproduces the exact token
+    stream an undisturbed run would have emitted — partial progress is
+    deliberately discarded rather than migrated (KV pages died with the
+    replica).  Identity (rid, prompt, max_new_tokens, arrival_s,
+    t_submit) is preserved; runtime state is cleared."""
+    req.state = WAITING
+    req.slot = None
+    req.pages = None
+    req.prefill_pos = 0
+    req.tokens = []
+    req.t_admit = None
+    req.t_first = None
+    req.t_done = None

@@ -149,6 +149,10 @@ def run_row(rec: dict) -> dict:
     # scripts/serve_bench.py) — rendered as its own section
     if summ.get("serving") is not None:
         row["serving"] = summ["serving"]
+    # fleet block (serving.Fleet.slo_report, filed by serve_bench
+    # --replicas N): per-replica SLO + the failover/swap event timeline
+    if summ.get("fleet") is not None:
+        row["fleet"] = summ["fleet"]
     # collective ledger (telemetry.ledger): measured contract verdict +
     # bus bandwidth from the compact manifest/summary block, per-(kind,
     # payload, axis) aggregates from the run dir's collectives.json —
@@ -330,6 +334,67 @@ def render_serving(rows: list[dict]) -> str:
             f"| {'0 ✓' if rt == 0 else _fmt(rt, 'd') if rt is not None else '—'} "
             f"| {mode} |")
     return "\n".join(out)
+
+
+# ----------------------------------------------------------------- fleet
+
+def render_fleet(rows: list[dict]) -> str:
+    """Per-replica SLO table + event timeline for every run that filed
+    a ``fleet`` block (``serving.Fleet.slo_report`` via ``serve_bench
+    --replicas N``).  One row per replica so a dead replica's partial
+    service and its survivors' absorbed load sit side by side; below
+    each run, the failover/shed/swap event timeline."""
+    frows = [r for r in rows if r.get("fleet")]
+    if not frows:
+        return "_no fleet runs_"
+    out = ["| run | replica | state | reqs | done | TTFT p50/p99 ms | "
+           "tok p50/p99 ms | tok/s | bursts | retraces |",
+           "|---|---|---|---|---|---|---|---|---|---|"]
+    lines = []
+    for r in sorted(frows, key=lambda r: r.get("run_id") or ""):
+        f = r["fleet"]
+        ttft = f.get("ttft_ms") or {}
+        ptl = f.get("per_token_ms") or {}
+        rt = f.get("recompiles_after_warmup")
+        out.append(
+            f"| {r.get('run_id', '—')} | **fleet** "
+            f"| {f.get('live', '—')}/{f.get('replicas', '—')} live "
+            f"| {_fmt(f.get('submitted'), 'd')} "
+            f"| {_fmt(f.get('completed'), 'd')} "
+            f"| {_fmt(ttft.get('p50'), '.1f')}/{_fmt(ttft.get('p99'), '.1f')} "
+            f"| {_fmt(ptl.get('p50'), '.2f')}/{_fmt(ptl.get('p99'), '.2f')} "
+            f"| — | — "
+            f"| {'0 ✓' if rt == 0 else _fmt(rt, 'd') if rt is not None else '—'} |")
+        for s in f.get("replica_slo") or []:
+            sttft = s.get("ttft_ms") or {}
+            sptl = s.get("per_token_ms") or {}
+            srt = s.get("recompiles_after_warmup")
+            state = s.get("state", "?")
+            if s.get("death"):
+                state += f" ({s['death']})"
+            out.append(
+                f"| {r.get('run_id', '—')} | {s.get('replica', '—')} "
+                f"| {state} "
+                f"| {_fmt(s.get('requests'), 'd')} "
+                f"| {_fmt(s.get('completed'), 'd')} "
+                f"| {_fmt(sttft.get('p50'), '.1f')}/{_fmt(sttft.get('p99'), '.1f')} "
+                f"| {_fmt(sptl.get('p50'), '.2f')}/{_fmt(sptl.get('p99'), '.2f')} "
+                f"| {_fmt(s.get('tokens_per_s'), '.1f')} "
+                f"| {_fmt(s.get('bursts'), 'd')} "
+                f"| {'0 ✓' if srt == 0 else _fmt(srt, 'd') if srt is not None else '—'} |")
+        shed = f.get("shed", 0)
+        drop = f.get("dropped", 0)
+        ev = f.get("events") or []
+        tl = "; ".join(
+            f"{e.get('t_s', '?')}s {e.get('event', '?')}"
+            + (f" r{e['replica']}" if "replica" in e else "")
+            + (f" ({e['trigger']})" if "trigger" in e else "")
+            for e in ev) or "none"
+        lines.append(f"- `{r.get('run_id', '—')}`: shed {shed}, "
+                     f"dropped {drop}"
+                     + (" ⚠" if drop else " ✓")
+                     + f"; events: {tl}")
+    return "\n".join(out) + "\n\n" + "\n".join(lines)
 
 
 # ---------------------------------------------------------------- lineage

@@ -29,6 +29,8 @@ burst time:
   ``ttft_ms``          this request's time-to-first-token (prefill)
   ``completed_requests`` per-request {rid, ttft_ms, per_token_ms,
                        tokens} retired at this burst's sync point
+  ``replica``          fleet replica index that emitted the event
+                       (absent on single-engine runs)
 """
 
 from __future__ import annotations
@@ -48,6 +50,7 @@ STEP_FIELDS = {
     "tflops_per_device": False,
     "peak_memory_gb": False,
     # serving-runtime extras (absent on training events)
+    "replica": False,
     "phase": False,
     "active": False,
     "admitted": False,

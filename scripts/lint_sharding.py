@@ -194,6 +194,14 @@ def main(argv=None) -> int:
         if pkg_dir.is_dir():
             findings += lint_tree(pkg_dir, recursive=True,
                                   checks={"swallowed-distributed-error"})
+        # the serving modules additionally get the host-sync lint: the
+        # engine/fleet hot path may only block at its declared sync
+        # points (each carries a `# sync-ok` pragma) — an undeclared
+        # block_until_ready in a decode loop is a latency bug
+        serving_dir = pkg_dir / "serving"
+        if serving_dir.is_dir():
+            findings += lint_tree(serving_dir, recursive=True,
+                                  checks={"host-sync-in-loop"})
         report["pitfalls"] = [f.to_dict() for f in findings]
         errors = [f for f in findings if f.severity == "error"]
         for f in findings:

@@ -153,6 +153,10 @@ def main(argv=None) -> int:
         if any(r.get("serving") for r in rows):
             print("\n## Serving SLO (TTFT / per-token latency)\n")
             print(R.render_serving(rows))
+        if any(r.get("fleet") for r in rows):
+            print("\n## Serving fleet (per-replica SLO + event "
+                  "timeline)\n")
+            print(R.render_fleet(rows))
         if any(r.get("lineage") for r in rows):
             print("\n## Restart lineage (stitched segments)\n")
             print(R.render_lineage(rows))

@@ -18,8 +18,22 @@ Exit is nonzero when either serving invariant breaks:
     tokens must be BITWISE equal to one-shot ``generate`` of the same
     prompt at the engine's pinned ``cache_capacity``.
 
+With ``--replicas N`` (N >= 2) the trace drives a ``serving.Fleet``
+instead: N engine replicas on separate device slices behind SLO-driven
+admission control, with failover (``--inject-fault kill_replica@N:k`` /
+``hang_decode@N:k`` / ``slow_replica@N:ms``), deadline load shedding
+(``--deadline-ms``, structured rejections; ``queue_full`` sheds
+backpressure the Poisson driver by shifting later arrivals), and
+zero-drop weight hot-swap (``--swap-at K`` [+ ``--swap-ckpt DIR``],
+``corrupt_swap`` proves the torn-checkpoint fallback).  The fleet adds
+a third hard gate: any DROPPED request — admitted but never completed,
+through kills, hangs, and swaps — exits nonzero (shed requests are
+rejections, not drops).
+
     python scripts/serve_bench.py --requests 64 --rate 16 --tp 2
     python scripts/serve_bench.py --requests 8 --disaggregate
+    python scripts/serve_bench.py --replicas 2 --inject-fault kill_replica@2:1
+    python scripts/serve_bench.py --replicas 2 --rate 200 --deadline-ms 400
 """
 
 from __future__ import annotations
@@ -92,7 +106,60 @@ def main(argv=None) -> int:
                         "trajectories chaotic, so the parity check "
                         "discriminates (1.0 = raw init, which settles "
                         "on a constant token)")
+    p.add_argument("--cpu-devices", type=int, default=None,
+                   help="simulate N CPU devices (the gloo-mode twin). "
+                        "Default: the live backend for a single engine, "
+                        "but the fleet path (--replicas > 1) self-"
+                        "selects max(8, replicas) simulated devices — "
+                        "a 1-chip host can't carve replica slices; "
+                        "pass 0 to force the live backend")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve through a Fleet of N engine replicas "
+                        "(failover + admission control + hot-swap; "
+                        "1 = single engine, the default)")
+    p.add_argument("--inject-fault", default=None, metavar="SPEC",
+                   help="serving fault: kill_replica@N:k / "
+                        "hang_decode@N:k / slow_replica@N:ms / "
+                        "corrupt_swap (needs --replicas >= 2)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request TTFT deadline; arrivals whose "
+                        "modeled TTFT exceeds it are shed at submit "
+                        "with a structured rejection")
+    p.add_argument("--swap-at", type=int, default=None, metavar="K",
+                   help="hot-swap weights after K completed requests "
+                        "(zero-drop drain, one replica at a time)")
+    p.add_argument("--swap-ckpt", default=None, metavar="DIR",
+                   help="checkpoint directory for --swap-at (default: "
+                        "save a seed+1 init to a temp dir)")
+    p.add_argument("--watchdog-timeout", type=float, default=5.0,
+                   help="per-replica decode watchdog budget, seconds "
+                        "(converts a wedged burst into failover)")
+    p.add_argument("--max-queue", type=int, default=8,
+                   help="admission bound on the modeled waiting line; "
+                        "deeper arrivals are shed queue_full")
+    p.add_argument("--burst-ms", type=float, default=50.0,
+                   help="admission controller's per-burst latency "
+                        "prior (EWMA-calibrated as bursts complete)")
     args = p.parse_args(argv)
+    # device selection must happen BEFORE the backend initializes (a
+    # live backend ignores the override), hence flag-driven, not
+    # count-driven: the fleet path defaults to the simulated mesh
+    # because counting live devices would itself pin the backend
+    cpu_n = args.cpu_devices
+    if cpu_n is None and args.replicas > 1:
+        cpu_n = max(8, args.replicas)
+    if cpu_n:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(cpu_n)
+    if args.replicas > 1:
+        return _fleet_main(args)
+    for flag, name in ((args.inject_fault, "--inject-fault"),
+                       (args.deadline_ms, "--deadline-ms"),
+                       (args.swap_at, "--swap-at")):
+        if flag is not None:
+            print(f"[serve] {name} needs --replicas >= 2",
+                  file=sys.stderr)
+            return 2
 
     import jax
     import numpy as np
@@ -193,6 +260,155 @@ def main(argv=None) -> int:
         export_main([telem.run_dir])
 
     print(json.dumps(slo, indent=1))
+    for f in failures:
+        print(f"[serve] FAIL: {f}", file=sys.stderr, flush=True)
+    return 1 if failures else 0
+
+
+def _fleet_main(args) -> int:
+    """The ``--replicas N`` path: drive the trace through a Fleet with
+    admission control, optional fault injection and hot-swap, and gate
+    on drops + retraces (+ parity when weights never change)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.models.generate import generate
+    from distributed_training_sandbox_tpu.serving import Fleet, Rejection
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
+
+    if args.tp > 1 or args.disaggregate:
+        print("[serve] --replicas composes whole-engine device slices; "
+              "--tp/--disaggregate inside a replica is not wired yet",
+              file=sys.stderr)
+        return 2
+    cfg = getattr(T, args.model)
+    key = jax.random.PRNGKey(args.seed)
+    params = T.init_params(key, cfg)
+    if args.param_scale != 1.0:
+        params = jax.tree.map(
+            lambda x: (x * args.param_scale).astype(x.dtype), params)
+
+    swap_dir = None
+    if args.swap_at is not None:
+        swap_dir = args.swap_ckpt
+        if swap_dir is None:
+            # no checkpoint given: save a seed+1 init to swap to — the
+            # "new weights" stand-in a train loop would have produced
+            from distributed_training_sandbox_tpu.resilience.state \
+                import Checkpointer, RunState
+            swap_dir = tempfile.mkdtemp(prefix="serve_swap_")
+            new_params = T.init_params(
+                jax.random.PRNGKey(args.seed + 1), cfg)
+            if args.param_scale != 1.0:
+                new_params = jax.tree.map(
+                    lambda x: (x * args.param_scale).astype(x.dtype),
+                    new_params)
+            ck = Checkpointer(swap_dir)
+            ck.save(RunState(params=new_params, step=0), wait=True)
+            ck.close()
+
+    rng = np.random.default_rng(args.seed)
+    trace = build_trace(rng, args.requests, args.rate, cfg.vocab_size,
+                        args.max_seq_len)
+    deadline_s = (None if args.deadline_ms is None
+                  else args.deadline_ms / 1e3)
+    backoff_s = args.burst_ms / 1e3
+
+    run_cfg = {"num_steps": 0, "batch_size": args.max_batch,
+               "sequence_length": args.max_seq_len, "seed": args.seed,
+               "requests": args.requests, "rate": args.rate,
+               "page_size": args.page_size,
+               "replicas": args.replicas,
+               "inject_fault": args.inject_fault,
+               "deadline_ms": args.deadline_ms,
+               "swap_at": args.swap_at,
+               "max_queue": args.max_queue}
+    prof = None
+    if args.profile:
+        from distributed_training_sandbox_tpu.utils.profiling import (
+            ProfileSchedule, Profiler)
+        prof = Profiler(trace_dir=args.trace_dir,
+                        schedule=ProfileSchedule(skip_first=2, wait=1,
+                                                 warmup=2, active=8))
+    failures = []
+    with TelemetryRun("fleet", model=args.model, config=run_cfg,
+                      profiler=prof) as telem:
+        fleet = Fleet(
+            params, cfg, replicas=args.replicas,
+            watchdog_timeout_s=args.watchdog_timeout,
+            fault=args.inject_fault, telem=telem,
+            max_queue=args.max_queue, burst_s_prior=backoff_s,
+            max_batch=args.max_batch, page_size=args.page_size,
+            max_seq_len=args.max_seq_len,
+            prefill_chunk=args.prefill_chunk,
+            sync_every=args.sync_every, kv_quant=args.kv_quant,
+            hbm_budget_gb=args.hbm_budget_gb)
+        admitted = []
+        offset = 0.0
+        for t, prompt, new in trace:
+            # queue_full backpressure INTO the driver: the open loop
+            # slows down by one modeled burst per overflow, the way a
+            # load balancer's 429s pace real clients
+            r = fleet.submit(prompt, max_new_tokens=new,
+                             arrival_s=t + offset,
+                             deadline_s=deadline_s)
+            if isinstance(r, Rejection):
+                if r.reason == "queue_full":
+                    offset += backoff_s
+            else:
+                admitted.append(r)
+        if args.swap_at is not None:
+            fleet.schedule_swap(swap_dir, after_completed=args.swap_at)
+        fleet.run()
+        slo = fleet.slo_report()
+        print(f"[serve] fleet x{args.replicas}: {slo['completed']} "
+              f"completed / {slo['shed']} shed / {slo['dropped']} "
+              f"dropped of {args.requests}; live "
+              f"{slo['live']}/{slo['replicas']}, TTFT p50 "
+              f"{slo['ttft_ms']['p50']} ms p99 {slo['ttft_ms']['p99']} "
+              f"ms; events: "
+              f"{[e['event'] for e in slo['events']] or 'none'}",
+              flush=True)
+
+        if slo["dropped"] > 0:
+            failures.append(
+                f"{slo['dropped']} admitted request(s) dropped "
+                f"(rids {fleet.dropped()[:8]}) — the zero-drop "
+                f"invariant is broken")
+        if slo["completed"] + slo["shed"] != args.requests:
+            failures.append(
+                f"bookkeeping leak: {slo['completed']} completed + "
+                f"{slo['shed']} shed != {args.requests} offered")
+        retr = slo["recompiles_after_warmup"]
+        if retr is None or retr > 0:
+            failures.append(f"jit cache grew after warmup: {retr}")
+        if args.swap_at is None:
+            for req in admitted[:args.check_parity]:
+                ref = np.asarray(generate(
+                    params, req.prompt[None], cfg,
+                    max_new_tokens=req.max_new_tokens,
+                    kv_quant=args.kv_quant,
+                    cache_capacity=fleet.view_capacity))[0]
+                got = np.asarray(req.tokens, np.int32)
+                if got.shape != ref.shape or not (got == ref).all():
+                    failures.append(
+                        f"rid {req.rid}: tokens diverge from one-shot "
+                        f"generate (got {got.tolist()[:8]}..., ref "
+                        f"{ref.tolist()[:8]}...)")
+            slo["parity_checked"] = min(args.check_parity,
+                                        len(admitted))
+        slo["failures"] = failures
+        telem.finalize(fleet=slo)
+
+    if args.export_timeline and telem.run_dir:
+        sys.path.insert(0, str(Path(__file__).resolve().parent))
+        from export_timeline import main as export_main
+        export_main([telem.run_dir])
+
+    print(json.dumps({k: v for k, v in slo.items()
+                      if k not in ("rejections", "events")}, indent=1))
     for f in failures:
         print(f"[serve] FAIL: {f}", file=sys.stderr, flush=True)
     return 1 if failures else 0

@@ -1,0 +1,381 @@
+"""Serving fleet suite — THE acceptance for replica failover: a
+``kill_replica`` mid-trace fleet completes every admitted request with
+token streams bitwise-identical to an undisturbed one-shot ``generate``
+(partial progress discarded, full deterministic replay on survivors); a
+wedged decode burst fails over through the watchdog in bounded time; an
+overload trace sheds a seed-reproducible set while every admitted
+request still completes; a mid-traffic weight hot-swap drops zero
+requests; and a torn swap checkpoint leaves the fleet serving on the
+old weights with a readable warning.  Plus the satellite invariants:
+the falsy-zero arrival timestamp sentinel, loud double-retire, pool
+bookkeeping across kill→replay churn, the fault-spec registry
+round-trips, and the ``serve_bench --replicas`` CI gate."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_sandbox_tpu.models import transformer as T
+from distributed_training_sandbox_tpu.models.generate import generate
+from distributed_training_sandbox_tpu.resilience.faults import (
+    FAULT_KINDS, FAULT_REGISTRY, parse_fault_spec)
+from distributed_training_sandbox_tpu.resilience.state import (
+    Checkpointer, RunState)
+from distributed_training_sandbox_tpu.serving import (
+    AdmissionController, ContinuousBatcher, Fleet, PageAllocator, Request)
+from distributed_training_sandbox_tpu.serving.scheduler import (
+    DONE, WAITING)
+
+pytestmark = pytest.mark.fleet
+
+
+def _chaotic_params(cfg, seed=0, scale=3.0):
+    """3x-scaled weights: chaotic greedy trajectories, so one-ulp drift
+    (or serving on the wrong weights) flips the continuation."""
+    params = T.init_params(jax.random.PRNGKey(seed), cfg)
+    return jax.tree.map(lambda x: (x * scale).astype(x.dtype), params)
+
+
+def _trace(cfg, n, seed=0, plen=5, span_s=0.3):
+    """Seeded fixed-length trace (one generate compile serves every
+    parity check): (prompt, arrival_s) pairs over ``span_s`` seconds of
+    virtual time, head pinned at 0.0."""
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, cfg.vocab_size, size=plen)
+               .astype(np.int32) for _ in range(n)]
+    arrivals = np.sort(rng.uniform(0.0, span_s, size=n))
+    arrivals[0] = 0.0
+    return list(zip(prompts, arrivals))
+
+
+def _assert_bitwise(fleet, params, reqs, max_new):
+    cfg = fleet.cfg
+    for r in reqs:
+        ref = np.asarray(generate(
+            params, r.prompt[None], cfg, max_new_tokens=max_new,
+            cache_capacity=fleet.view_capacity))[0]
+        got = np.asarray(r.tokens, np.int32)
+        assert got.shape == ref.shape and (got == ref).all(), \
+            f"rid {r.rid}: {got.tolist()} != {ref.tolist()}"
+
+
+_ENG = dict(max_batch=2, page_size=8, max_seq_len=32, prefill_chunk=8,
+            sync_every=2)
+
+
+# ---- satellite: the falsy-zero arrival sentinel -------------------------
+
+def test_submit_preserves_zero_arrival_timestamp():
+    """arrival_s=0.0 is the head of every virtual trace and must become
+    t_submit verbatim — the falsy-zero bug would stamp wall time."""
+    b = ContinuousBatcher(2, PageAllocator(8), page_size=8)
+    head = Request(rid=0, prompt=np.ones(4, np.int32),
+                   max_new_tokens=4, arrival_s=0.0)
+    b.submit(head, now=123.45)
+    assert head.t_submit == 0.0          # NOT 123.45
+    walkin = Request(rid=1, prompt=np.ones(4, np.int32),
+                     max_new_tokens=4)   # arrival_s=None → "now"
+    b.submit(walkin, now=123.45)
+    assert walkin.t_submit == 123.45
+
+
+# ---- satellite: loud double-retire --------------------------------------
+
+def test_double_retire_rejected():
+    b = ContinuousBatcher(2, PageAllocator(8), page_size=8)
+    req = Request(rid=0, prompt=np.ones(4, np.int32), max_new_tokens=4)
+    b.submit(req, now=0.0)
+    assert b.admit(now=0.0) == [req]
+    b.retire(req, now=1.0)
+    assert req.state == DONE and b.completed_total == 1
+    with pytest.raises(ValueError, match="double retire|not resident"):
+        b.retire(req, now=2.0)
+    assert b.completed_total == 1        # the rejected retire took nothing
+    # a foreign request (never admitted here) is rejected the same way
+    with pytest.raises(ValueError, match="not resident"):
+        b.retire(Request(rid=9, prompt=np.ones(4, np.int32),
+                         max_new_tokens=4), now=2.0)
+
+
+# ---- satellite: pool bookkeeping across kill→replay churn ---------------
+
+def test_release_all_restores_pool_and_replays_cleanly():
+    """Failover teardown: release_all frees every page and slot, resets
+    requests to just-submitted (identity preserved), and a survivor
+    batcher re-admits them against a clean allocator — counters stay
+    consistent across the kill→replay cycle."""
+    alloc = PageAllocator(8)             # 7 usable pages
+    b = ContinuousBatcher(2, alloc, page_size=8)
+    reqs = [Request(rid=i, prompt=np.ones(6, np.int32),
+                    max_new_tokens=4, arrival_s=0.1 * i)
+            for i in range(3)]
+    for r in reqs:
+        b.submit(r, now=0.0)
+    admitted = b.admit(now=0.0)          # 2 slots → rid 0,1 resident
+    assert [r.rid for r in admitted] == [0, 1]
+    assert alloc.pages_in_use == 4 and b.waiting  # 2 pages each
+    reqs[0].tokens = [7, 8]              # fake partial decode progress
+
+    orphans = b.release_all()
+    # resident (slot order) first, then waiting FCFS
+    assert [r.rid for r in orphans] == [0, 1, 2]
+    assert alloc.free_pages == 7 and alloc.pages_in_use == 0
+    assert not b.has_work()
+    for r in orphans:
+        assert r.state == WAITING and r.slot is None and r.pages is None
+        assert r.tokens == [] and r.t_admit is None and r.t_done is None
+        assert r.t_submit == 0.1 * r.rid    # identity preserved
+    # counters are NOT rewound on the dead batcher…
+    assert b.admitted_total == 2 and b.completed_total == 0
+
+    # …and the survivor counts the re-admission normally
+    b2 = ContinuousBatcher(2, PageAllocator(8), page_size=8)
+    for r in orphans:
+        b2.submit(r, now=1.0)
+    readmitted = b2.admit(now=1.0)
+    assert [r.rid for r in readmitted] == [0, 1]
+    assert b2.admitted_total == 2
+    for r in readmitted:
+        b2.retire(r, now=2.0)
+    assert b2.completed_total == 2
+    assert b2.allocator.free_pages == 7
+
+
+# ---- satellite: one fault registry, round-tripped -----------------------
+
+def test_fault_registry_round_trips_every_kind():
+    """Every registered kind's example spec parses and str()s back to
+    itself — the registry is the single source the parser, the error
+    messages and the CLI help all derive from."""
+    assert set(FAULT_KINDS) == set(FAULT_REGISTRY)
+    for name, info in FAULT_REGISTRY.items():
+        assert info.name == name
+        spec = parse_fault_spec(info.example)
+        assert spec is not None and spec.kind == name
+        assert str(spec) == info.example
+    assert parse_fault_spec(None) is None
+    assert parse_fault_spec("") is None
+
+
+@pytest.mark.parametrize("bad", [
+    "bogus@3",                 # unknown kind
+    "crash",                   # step-required kind without @step
+    "hang_decode:1",           # ditto, serving kind
+    "kill_replica@2:fast",     # int-target kind, non-int target
+    "slow_replica@1:soon",     # ditto, ms target
+])
+def test_fault_parse_rejects_malformed(bad):
+    with pytest.raises(SystemExit):
+        parse_fault_spec(bad)
+
+
+# ---- satellite-adjacent: the admission model is pure bookkeeping --------
+
+def test_admission_controller_models_queue_and_deadline():
+    adm = AdmissionController(2, max_queue=2, burst_s=0.05,
+                              steps_per_burst=4, calibrate=False)
+    # 2 slots: the first three arrivals model no waiting (the third
+    # sees depth 2, still within capacity)
+    for _ in range(3):
+        reason, ttft, _ = adm.offer(0.0, max_new_tokens=4)
+        assert reason is None and ttft == pytest.approx(0.05)
+    # fourth models one waiter; a 60 ms deadline can't hold 100 ms TTFT
+    reason, ttft, _ = adm.offer(0.0, 4, deadline_s=0.06)
+    assert reason == "deadline" and ttft == pytest.approx(0.10)
+    # a shed offer takes no capacity: without a deadline it is admitted…
+    assert adm.offer(0.0, 4)[0] is None
+    # …and the next one overflows the bounded queue
+    assert adm.offer(0.0, 4)[0] == "queue_full"
+    assert adm.offered_total == 6 and adm.shed_total == 2
+    # backlog drains on the virtual clock: far-future arrival sees empty
+    assert adm.offer(10.0, 4)[0] is None
+
+
+# ---- HEADLINE: kill_replica mid-trace, bitwise replay -------------------
+
+def test_kill_replica_failover_completes_bitwise():
+    """A replica killed mid-trace: its in-flight requests replay on the
+    survivor and EVERY admitted request completes bitwise-identical to
+    an undisturbed one-shot generate — plus the churn invariants: zero
+    drops, pool bookkeeping consistent, no post-warmup retraces on the
+    survivor."""
+    cfg = T.TINY_LM
+    params = _chaotic_params(cfg)
+    fleet = Fleet(params, cfg, replicas=2, watchdog_timeout_s=0.0,
+                  fault="kill_replica@1:1", max_queue=16, **_ENG)
+    reqs = [fleet.submit(p, max_new_tokens=5, arrival_s=t)
+            for p, t in _trace(cfg, 10, seed=3)]
+    done = fleet.run()
+
+    assert len(done) == 10 and fleet.dropped() == []
+    dead = fleet.replicas[1]
+    assert dead.state == "dead" and dead.death == "WorkerLost"
+    ev = [e for e in fleet.events if e["event"] == "replica_dead"]
+    assert len(ev) == 1 and ev[0]["replica"] == 1
+    assert ev[0]["trigger"] == "WorkerLost" and ev[0]["requeued"] >= 1
+    _assert_bitwise(fleet, params, reqs, max_new=5)
+    # every admission is accounted for: completions across the fleet
+    # equal the trace, re-admissions only ever add on the survivor side
+    slo = fleet.slo_report()
+    per = {b["replica"]: b for b in slo["replica_slo"]}
+    assert sum(b["completed"] for b in per.values()) == 10
+    assert per[0]["requests"] + per[1]["requests"] >= 10  # replay re-admits
+    assert slo["completed"] == 10 and slo["dropped"] == 0
+    assert fleet.replicas[0].engine.pool.allocator.pages_in_use == 0
+    assert fleet.retraces_after_warmup() == 0   # survivor only
+
+
+# ---- HEADLINE: hang_decode → watchdog failover in bounded time ----------
+
+def test_hang_decode_watchdog_failover_bounded():
+    """A wedged decode burst never returns on its own — the watchdog
+    converts it to StepTimeoutError within its timeout and the fleet
+    fails over; every request still completes bitwise."""
+    import time
+    cfg = T.TINY_LM
+    params = _chaotic_params(cfg, seed=1)
+    # hang_decode@1:0 = wedge replica 0's burst 1 (KIND@BURST:replica)
+    fleet = Fleet(params, cfg, replicas=2, watchdog_timeout_s=0.5,
+                  fault="hang_decode@1:0", max_queue=16, **_ENG)
+    reqs = [fleet.submit(p, max_new_tokens=5, arrival_s=t)
+            for p, t in _trace(cfg, 8, seed=5)]
+    t0 = time.perf_counter()
+    done = fleet.run()
+    wall = time.perf_counter() - t0
+
+    assert len(done) == 8 and fleet.dropped() == []
+    assert fleet.replicas[0].death == "StepTimeoutError"
+    ev = [e for e in fleet.events if e["event"] == "replica_dead"]
+    assert ev and ev[0]["replica"] == 0
+    assert ev[0]["trigger"] == "StepTimeoutError"
+    # bounded: the whole run (compile included) finishes in seconds —
+    # without the watchdog the wedged burst would hang forever
+    assert wall < 120.0
+    _assert_bitwise(fleet, params, reqs, max_new=5)
+
+
+# ---- HEADLINE: overload sheds deterministically, admitted complete ------
+
+def test_overload_shed_is_deterministic_and_admitted_complete():
+    """A deliberately over-tight fleet (1 replica, deep trace, short
+    deadline, frozen prior) sheds a NONEMPTY set decided at submit time
+    — reproducible request-for-request from the seed — while every
+    admitted request still completes."""
+    cfg = T.TINY_LM
+    params = _chaotic_params(cfg, seed=2)
+    trace = _trace(cfg, 24, seed=11, span_s=0.05)  # near-simultaneous
+
+    def offer_all(fleet):
+        shed, admitted = [], []
+        for p, t in trace:
+            out = fleet.submit(p, max_new_tokens=5, arrival_s=t,
+                               deadline_s=0.25)
+            (admitted if isinstance(out, Request) else shed).append(out)
+        return shed, admitted
+
+    fleet = Fleet(params, cfg, replicas=1, watchdog_timeout_s=0.0,
+                  max_queue=2, burst_s_prior=0.05,
+                  calibrate_admission=False, **_ENG)
+    shed, admitted = offer_all(fleet)
+    assert shed and admitted                      # both sides nonempty
+    assert {r.reason for r in shed} <= {"queue_full", "deadline"}
+    for r in shed:                                # structured + honest
+        if r.reason == "deadline":
+            assert r.modeled_ttft_ms > r.deadline_ms
+    done = fleet.run()
+    assert len(done) == len(admitted) and fleet.dropped() == []
+    slo = fleet.slo_report()
+    assert slo["shed"] == len(shed) == slo["admission"]["shed"]
+    assert slo["submitted"] + slo["shed"] == len(trace)
+
+    # the same trace through a fresh fleet sheds the identical set
+    fleet2 = Fleet(params, cfg, replicas=1, watchdog_timeout_s=0.0,
+                   max_queue=2, burst_s_prior=0.05,
+                   calibrate_admission=False, **_ENG)
+    shed2, _ = offer_all(fleet2)
+    assert [(r.rid, r.reason) for r in shed2] == \
+        [(r.rid, r.reason) for r in shed]
+
+
+# ---- HEADLINE: zero-drop weight hot-swap --------------------------------
+
+def test_hot_swap_zero_drop_and_new_weights_take(tmp_path):
+    """schedule_swap mid-traffic: replicas drain one at a time, zero
+    requests drop, and completions partition cleanly into old-weight
+    and new-weight token streams (none ambiguous, both sides present)."""
+    cfg = T.TINY_LM
+    old = _chaotic_params(cfg, seed=0)
+    new = _chaotic_params(cfg, seed=7)
+    ck = Checkpointer(tmp_path / "swap")
+    ck.save(RunState(params=new, step=0), wait=True)
+    ck.close()
+
+    fleet = Fleet(old, cfg, replicas=2, watchdog_timeout_s=0.0,
+                  max_queue=32, **_ENG)
+    reqs = [fleet.submit(p, max_new_tokens=5, arrival_s=t)
+            for p, t in _trace(cfg, 12, seed=13, span_s=0.6)]
+    fleet.schedule_swap(tmp_path / "swap", after_completed=4)
+    done = fleet.run()
+
+    assert len(done) == 12 and fleet.dropped() == []
+    names = [e["event"] for e in fleet.events]
+    assert names.count("swap_replica") == 2
+    assert names.index("swap_started") < names.index("swap_complete")
+    assert all(r.state == "live" for r in fleet.replicas)
+
+    n_old = n_new = 0
+    for r in reqs:
+        got = np.asarray(r.tokens, np.int32)
+        refs = {}
+        for tag, params in (("old", old), ("new", new)):
+            refs[tag] = np.asarray(generate(
+                params, r.prompt[None], cfg, max_new_tokens=5,
+                cache_capacity=fleet.view_capacity))[0]
+        m_old = bool((got == refs["old"]).all())
+        m_new = bool((got == refs["new"]).all())
+        assert m_old or m_new, \
+            f"rid {r.rid} matches NEITHER weight set: {got.tolist()}"
+        n_old += m_old and not m_new
+        n_new += m_new and not m_old
+    # traffic flowed across the boundary: both weight sets served
+    assert n_old >= 1 and n_new >= 1, (n_old, n_new)
+
+
+# ---- HEADLINE: corrupt_swap keeps the fleet on the old weights ----------
+
+def test_corrupt_swap_keeps_serving_old_weights(tmp_path, capfd):
+    """The corrupt_swap fault tears the swap checkpoint before restore:
+    the swap aborts with a readable warning, and every request — before
+    AND after the attempt — completes on the OLD weights."""
+    cfg = T.TINY_LM
+    old = _chaotic_params(cfg, seed=0)
+    new = _chaotic_params(cfg, seed=9)
+    ck = Checkpointer(tmp_path / "swap")
+    ck.save(RunState(params=new, step=0), wait=True)
+    ck.close()
+
+    fleet = Fleet(old, cfg, replicas=2, watchdog_timeout_s=0.0,
+                  fault="corrupt_swap", max_queue=32, **_ENG)
+    reqs = [fleet.submit(p, max_new_tokens=5, arrival_s=t)
+            for p, t in _trace(cfg, 8, seed=17)]
+    fleet.schedule_swap(tmp_path / "swap", after_completed=3)
+    done = fleet.run()
+
+    assert len(done) == 8 and fleet.dropped() == []
+    names = [e["event"] for e in fleet.events]
+    assert "swap_fault_injected" in names and "swap_failed" in names
+    assert "swap_replica" not in names       # no replica ever swapped
+    err = capfd.readouterr().err
+    assert "fleet keeps serving on the previous weights" in err
+    _assert_bitwise(fleet, old, reqs, max_new=5)   # OLD weights, all 8
+
+
+# ---- satellite: the serve_bench fleet CI gate ---------------------------
+
+def test_serve_bench_fleet_gate():
+    """``serve_bench --replicas 2`` is its own CI gate: nonzero exit on
+    any dropped request, bookkeeping leak, or post-warmup retrace."""
+    from scripts.serve_bench import main
+    assert main(["--replicas", "2", "--requests", "8",
+                 "--check-parity", "2", "--max-batch", "2",
+                 "--sync-every", "2"]) == 0
