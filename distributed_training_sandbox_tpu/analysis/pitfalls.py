@@ -55,6 +55,17 @@ traces it), tuned so the current ``scripts/`` tree is clean at the
     choice.  A deliberate monolithic gather (e.g. the baseline leg of
     an A/B) marks the line — or the line above — with ``# gather-ok``.
 
+  * ``span-name-not-static`` (error) — a span/metric emit site
+    (``maybe_span`` / ``spans.span`` / ``spans.record`` /
+    ``metrics.inc|set|observe`` and their ``maybe_*`` guards) whose
+    name argument is not a static string literal: an f-string or
+    concatenation mints a new series per distinct value — unbounded
+    cardinality that bloats the Prometheus endpoint and shatters the
+    timeline into one-off tracks.  Keep the name static and put the
+    variation in attrs/labels.  A call site whose dynamic name draws
+    from a provably closed set marks the call line — or the line above
+    — with ``# span-ok``.
+
 Findings carry a severity; ``scripts/lint_sharding.py`` fails the run
 only on errors (``--strict`` promotes warnings).
 """
@@ -154,6 +165,7 @@ class _Visitor(ast.NodeVisitor):
         self.has_ring_variant = False
         self.gathers_in_step: list[tuple[int, str]] = []
         self.swallowed: list[tuple[int, str]] = []
+        self.dynamic_emit_names: list[tuple[int, str]] = []
 
     # -- context tracking -------------------------------------------------
     def _visit_function(self, node):
@@ -241,7 +253,33 @@ class _Visitor(ast.NodeVisitor):
             self._check_host_sync(node, chain, leaf, root)
         if _is_jit_call(node):
             self._check_donation(node)
+        self._check_emit_name(node, chain, leaf)
         self.generic_visit(node)
+
+    def _check_emit_name(self, node: ast.Call, chain: str,
+                         leaf: str) -> None:
+        """The span-name-not-static check: find the name argument of a
+        telemetry emit call and require a string literal."""
+        low = chain.lower()
+        if leaf in ("maybe_span", "maybe_inc", "maybe_set",
+                    "maybe_observe"):
+            idx = 1          # (stream_or_registry, name, ...)
+        elif leaf == "span" and isinstance(node.func, ast.Attribute):
+            idx = 0          # <spans>.span(name, ...)
+        elif leaf == "record" and "span" in low:
+            idx = 0          # <spans>.record(name, ...)
+        elif leaf in ("inc", "set", "observe") and "metric" in low:
+            idx = 0          # <metrics>.inc/set/observe(name, ...)
+        else:
+            return
+        name_arg = node.args[idx] if len(node.args) > idx else next(
+            (k.value for k in node.keywords if k.arg == "name"), None)
+        if name_arg is None:
+            return
+        if isinstance(name_arg, ast.Constant) \
+                and isinstance(name_arg.value, str):
+            return
+        self.dynamic_emit_names.append((node.lineno, chain or leaf))
 
     def _check_host_sync(self, node: ast.Call, chain: str, leaf: str,
                          root: str) -> None:
@@ -377,6 +415,16 @@ def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
                 f"(overlap='ring') so its hops can hide behind compute, "
                 f"or mark a deliberate monolithic gather with "
                 f"'# gather-ok'"))
+    for line, chain in v.dynamic_emit_names:
+        if _pragma(line, "span-ok"):
+            continue
+        findings.append(PitfallFinding(
+            path, line, "span-name-not-static", SEV_ERROR,
+            f"{chain}() with a non-literal span/metric name — a dynamic "
+            f"name mints a new series per distinct value (unbounded "
+            f"cardinality); keep the name a static string and put the "
+            f"variation in attrs/labels, or mark a provably-closed name "
+            f"set with '# span-ok'"))
     if v.collective_calls and not v.uses_shard_wrapper:
         line, chain = v.collective_calls[0]
         findings.append(PitfallFinding(

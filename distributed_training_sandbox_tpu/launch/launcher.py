@@ -255,6 +255,10 @@ def run_training(config: LaunchConfig, *, script: str | None = None,
 
     env = os.environ.copy()
     env["TRACE_DIR"] = str(trace_dir)
+    # group id shared by every worker of this launch: each rank's
+    # TelemetryRun stamps it into its manifest (extra.launch_group), and
+    # scripts/fleet_timeline.py groups the per-rank run dirs by it
+    env["DTS_LAUNCH_GROUP"] = f"{config.name}-{run_id}"
     env.update({k: str(v) for k, v in config.env.items()})
 
     nprocs = int(config.nprocs or 1)

@@ -175,6 +175,17 @@ class FaultInjector:
                 f"injected crash at step {step}"
                 + (f" ({scope})" if scope else ""))
         if kind == "slow":
+            # multi-process straggler selection: every worker parses the
+            # same --inject-fault argv, so without a filter ALL ranks
+            # would sleep and no rank lags its peers.  DTS_FAULT_RANK
+            # (set via LaunchConfig.env) restricts the sleep to one
+            # rank — the shape fleet_timeline's straggler report must
+            # attribute.  Unset = legacy behavior (every parser fires).
+            only = os.environ.get("DTS_FAULT_RANK")
+            if only is not None and only != "" and \
+                    int(only) != int(os.environ.get("DTS_PROCESS_ID",
+                                                    "0") or 0):
+                return
             time.sleep(int(self.spec.target or "100") / 1000.0)
             return
         if kind == "hang":

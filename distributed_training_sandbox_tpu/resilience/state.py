@@ -196,6 +196,9 @@ class Checkpointer:
         # with a TelemetryRun assign it so each save's blocking portion
         # shows up as a checkpoint/save span on the merged timeline
         self.spans = None
+        # live MetricsRegistry, same late-assignment pattern
+        # (``ckpt.metrics = telem.metrics``); feeds are None-tolerant
+        self.metrics = None
 
     @property
     def mgr(self):
@@ -279,6 +282,8 @@ class Checkpointer:
                         step=int(state.step), wait=bool(wait)):
             save_run_state(self.mgr, state, wait=wait,
                            fingerprint=self.fingerprint)
+        from ..telemetry.metrics import maybe_inc
+        maybe_inc(self.metrics, "checkpoint_saves_total")
         self._saved_steps.add(state.step)
         self._prune_meta()
 

@@ -75,6 +75,9 @@ class DevicePrefetcher(Iterator[Any]):
         # afterwards (``pref.spans = telem.spans``); records the
         # consumer's queue waits and the producer thread's staging time
         self.spans = spans
+        # live MetricsRegistry, same late-assignment pattern
+        # (``pref.metrics = telem.metrics``); both feeds None-tolerant
+        self.metrics = None
         self._it = iter(it)
         self._put = transform if transform is not None \
             else (lambda b: sharded_put(b, mesh, spec))
@@ -87,12 +90,14 @@ class DevicePrefetcher(Iterator[Any]):
 
     # ---- producer (background thread) -----------------------------------
     def _produce(self) -> None:
+        from ..telemetry.metrics import maybe_inc
         from ..telemetry.spans import maybe_span
         try:
             for item in self._it:
                 with maybe_span(self.spans, "prefetch/stage",
                                 cat="prefetch"):
                     staged = self._put(item)
+                maybe_inc(self.metrics, "prefetch_staged_total")
                 while not self._stop.is_set():
                     try:
                         self._q.put(staged, timeout=0.1)
@@ -120,9 +125,14 @@ class DevicePrefetcher(Iterator[Any]):
     def __next__(self) -> Any:
         if self._closed:
             raise StopIteration
+        import time
+        from ..telemetry.metrics import maybe_observe
         from ..telemetry.spans import maybe_span
+        t0 = time.perf_counter()
         with maybe_span(self.spans, "prefetch/wait", cat="prefetch"):
             item = self._q.get()
+        maybe_observe(self.metrics, "prefetch_wait_seconds",
+                      time.perf_counter() - t0)
         if isinstance(item, _End):
             self.close()
             raise StopIteration

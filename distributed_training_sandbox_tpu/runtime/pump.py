@@ -91,6 +91,9 @@ class StepPump:
 
     def _count(self, reason: str) -> None:
         self.sync_breakdown[reason] = self.sync_breakdown.get(reason, 0) + 1
+        from ..telemetry.metrics import maybe_inc
+        maybe_inc(getattr(self.telem, "metrics", None),
+                  "pump_host_sync_total", reason=reason)
 
     def _block(self, arr, step: int | None = None,
                reason: str = "sync") -> None:
@@ -100,7 +103,9 @@ class StepPump:
         actually stalls)."""
         import jax
         from ..telemetry.spans import maybe_span
-        with maybe_span(getattr(self.telem, "spans", None),
+        # the reason set is closed (per_step/profile_boundary/sync_every/
+        # throttle/drain/exit), so the span-name cardinality is bounded
+        with maybe_span(getattr(self.telem, "spans", None),  # span-ok
                         f"pump/{reason}", cat="pump", step=step):
             if self.watchdog is not None:
                 self.watchdog.block(jax.block_until_ready, arr, step=step)

@@ -345,6 +345,10 @@ class ServingEngine:
         self.mesh = mesh
         self.tp_axis = tp_axis if mesh is not None else None
         self.telem = telem
+        # fleet replica index (set by Fleet at construction); stamped
+        # into this engine's serve spans so a merged timeline can tell
+        # the dead replica's attempt from the survivor's replay
+        self.replica = None
         self.disaggregate = bool(disaggregate)
         # collective watchdog (resilience.elastic.Watchdog): every
         # blocking point in the decode path — the pump's sync sites and
@@ -468,6 +472,7 @@ class ServingEngine:
         self.batcher = ContinuousBatcher(self.max_batch,
                                          self.pool.allocator,
                                          self.page_size)
+        self.batcher.metrics = getattr(telem, "metrics", None)
         self._pending: list[Request] = []
         self.completed: list[Request] = []
         self._rid = 0
@@ -494,6 +499,9 @@ class ServingEngine:
                       max_new_tokens=int(max_new_tokens),
                       arrival_s=(None if arrival_s is None
                                  else float(arrival_s)))
+        # single-engine runs have no Router in front; mint the trace id
+        # here with the same shape the fleet router uses
+        req.trace_id = f"tr-{req.rid:06d}"
         self._rid += 1
         self._pending.append(req)
         return req
@@ -586,14 +594,22 @@ class ServingEngine:
         prefill_s = time.perf_counter() - t_chunk
         spans = getattr(self.telem, "spans", None)
         if spans is not None:
+            # t_submit/t_admit/t_first ride along (engine-clock seconds)
+            # so fleet_timeline can decompose TTFT into queue wait +
+            # prefill without re-deriving request state
             spans.record("serve/prefill_chunk", start_perf=t_chunk,
                          end_perf=time.perf_counter(), cat="serve",
-                         rid=req.rid, n_prompt=int(req.n_prompt))
+                         rid=req.rid, n_prompt=int(req.n_prompt),
+                         request_id=req.rid, trace_id=req.trace_id,
+                         replica=self.replica,
+                         t_submit_s=req.t_submit, t_admit_s=req.t_admit,
+                         t_first_s=req.t_first)
         if self.telem is not None:
             self.telem.step(
                 loss=None, tokens=req.n_prompt,
                 tracker_metrics={"last_step_time_s": prefill_s},
                 phase="prefill", rid=req.rid,
+                request_id=req.rid, trace_id=req.trace_id,
                 ttft_ms=round(1e3 * (req.ttft_s or 0.0), 3),
                 pool_util=round(self.pool.utilization, 4))
         b = req.slot
@@ -656,7 +672,7 @@ class ServingEngine:
         if spans is not None:
             spans.record("serve/decode_burst", start_perf=t_burst,
                          end_perf=time.perf_counter(), cat="serve",
-                         steps=int(sync))
+                         steps=int(sync), replica=self.replica)
         t_book = time.perf_counter()
         active, lengths = A0.copy(), L0.copy()
         occ_burst, emitted = [], 0
@@ -693,6 +709,7 @@ class ServingEngine:
                 pool_util=round(self.pool.utilization, 4),
                 completed_requests=[
                     {"rid": r.rid,
+                     "trace_id": r.trace_id,
                      "ttft_ms": round(1e3 * (r.ttft_s or 0.0), 3),
                      "per_token_ms": round(1e3 * (r.per_token_s or 0.0),
                                            3),

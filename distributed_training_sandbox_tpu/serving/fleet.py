@@ -73,6 +73,10 @@ class _ReplicaTelem:
     def spans(self):
         return getattr(self._telem, "spans", None)
 
+    @property
+    def metrics(self):
+        return getattr(self._telem, "metrics", None)
+
     def step(self, **kw):
         kw.setdefault("replica", self.replica)
         return self._telem.step(**kw)
@@ -148,6 +152,7 @@ class Fleet:
                 telem=_ReplicaTelem(telem, i) if telem is not None
                 else None,
                 **engine_kwargs)
+            eng.replica = i
             self.replicas.append(Replica(i, eng, wd, hb))
 
         eng0 = self.replicas[0].engine
@@ -157,6 +162,7 @@ class Fleet:
             burst_s=burst_s_prior, steps_per_burst=eng0.sync_every,
             calibrate=calibrate_admission)
         self.router = Router(self.admission)
+        self.router.metrics = getattr(telem, "metrics", None)
 
         self._pending: list[Request] = []
         self._rid = 0
@@ -306,6 +312,9 @@ class Fleet:
         self._event(now, "replica_dead", replica=rep.idx,
                     trigger=type(exc).__name__, burst=rep.bursts,
                     requeued=len(orphans))
+        from ..telemetry.metrics import maybe_inc
+        maybe_inc(getattr(self.telem, "metrics", None),
+                  "fleet_replica_deaths_total", replica=rep.idx)
         if not survivors:
             raise RuntimeError(
                 f"all {len(self.replicas)} replicas dead — last "
@@ -360,6 +369,11 @@ class Fleet:
                         rep.bursts += 1
                         if rep.heartbeat is not None:
                             rep.heartbeat.beat(rep.bursts)
+                            from ..telemetry.metrics import maybe_inc
+                            maybe_inc(
+                                getattr(self.telem, "metrics", None),
+                                "heartbeat_beats_total",
+                                replica=rep.idx)
                         self.completed.extend(done)
                         progressed = True
                     except (WorkerLost, StepTimeoutError) as e:

@@ -125,18 +125,31 @@ class Router:
         self.queue: deque[Request] = deque()
         self.rejections: list[Rejection] = []
         self.dispatched_total = 0
+        # live MetricsRegistry, late-assigned by the fleet
+        # (``router.metrics = telem.metrics``); feeds are None-tolerant
+        self.metrics = None
 
     def submit(self, req: Request,
                deadline_s: float | None = None) -> Rejection | None:
         """Admission decision for one request at its (virtual) arrival.
         Returns the Rejection when shed (the request never enters the
         system), None when admitted — the caller then feeds it to
-        :meth:`enqueue` once its arrival time is due."""
+        :meth:`enqueue` once its arrival time is due.
+
+        Mints the request's distributed ``trace_id`` here — submit is
+        the single front door, so every attempt at serving this request
+        (admission decision, prefill chunks, decode bursts, a failover
+        replay on a different replica) shares the one id."""
+        from ..telemetry.metrics import maybe_inc
+        if req.trace_id is None:
+            req.trace_id = f"tr-{req.rid:06d}"
+        maybe_inc(self.metrics, "router_offered_total")
         arrival = req.arrival_s if req.arrival_s is not None else 0.0
         reason, ttft_s, depth = self.admission.offer(
             arrival, req.max_new_tokens, deadline_s)
         if reason is None:
             return None
+        maybe_inc(self.metrics, "router_shed_total", reason=reason)
         rej = Rejection(
             rid=req.rid, reason=reason, t_s=arrival,
             modeled_ttft_ms=1e3 * ttft_s,
@@ -170,5 +183,8 @@ class Router:
             self.queue.popleft()
             rep.engine.enqueue(req, now)
             self.dispatched_total += 1
+            from ..telemetry.metrics import maybe_inc
+            maybe_inc(self.metrics, "router_dispatched_total",
+                      replica=rep.idx)
             sent.append((rep, req))
         return sent

@@ -52,6 +52,12 @@ class Request:
     #: sentinel, NOT falsy-0.0 — the first request of every virtual
     #: trace legitimately arrives at 0.0 and must keep that timestamp.
     arrival_s: float | None = None
+    #: distributed trace id, minted once at Router.submit (or by the
+    #: single engine's submit).  Part of request IDENTITY, not runtime
+    #: state: ``reset_for_replay`` preserves it, so every span/step
+    #: event from a dead replica's attempt and its survivor replay
+    #: joins into one swimlane.
+    trace_id: str | None = None
 
     state: str = WAITING
     slot: int | None = None
@@ -96,6 +102,8 @@ class ContinuousBatcher:
         self.slots: list[Request | None] = [None] * self.max_batch
         self.admitted_total = 0
         self.completed_total = 0
+        # live MetricsRegistry, late-assigned by the engine; None-safe
+        self.metrics = None
 
     # ---- queries ------------------------------------------------------
     def has_work(self) -> bool:
@@ -145,6 +153,8 @@ class ContinuousBatcher:
             req.t_admit = now
             self.slots[req.slot] = req
             self.admitted_total += 1
+            from ..telemetry.metrics import maybe_inc
+            maybe_inc(self.metrics, "batcher_admitted_total")
             admitted.append(req)
         return admitted
 
@@ -166,6 +176,8 @@ class ContinuousBatcher:
         req.state = DONE
         req.t_done = now
         self.completed_total += 1
+        from ..telemetry.metrics import maybe_inc
+        maybe_inc(self.metrics, "batcher_completed_total")
 
     def release_all(self) -> list[Request]:
         """Failover teardown: free every resident request's slot and
@@ -196,7 +208,7 @@ def reset_for_replay(req: Request) -> None:
     stream an undisturbed run would have emitted — partial progress is
     deliberately discarded rather than migrated (KV pages died with the
     replica).  Identity (rid, prompt, max_new_tokens, arrival_s,
-    t_submit) is preserved; runtime state is cleared."""
+    t_submit, trace_id) is preserved; runtime state is cleared."""
     req.state = WAITING
     req.slot = None
     req.pages = None

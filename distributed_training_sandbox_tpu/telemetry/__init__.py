@@ -14,7 +14,9 @@ Every training script emits the same three artifacts under
     the owned profiler session and the trace directory;
   * ``spans.jsonl``    — host-side phase spans (:mod:`.spans`): prefetch
     waits, pump sync barriers, checkpoint saves, serving bursts —
-    merged with the device trace by ``scripts/export_timeline.py``;
+    merged with the device trace by ``scripts/export_timeline.py`` and
+    across ranks by ``scripts/fleet_timeline.py`` (each stream writes a
+    ``clock_anchor.json`` epoch↔perf_counter sidecar for the merge);
   * ``collectives.json`` — the :mod:`.ledger` CollectiveLedger: per
     compiled collective instruction, measured duration + payload bytes
     + achieved algo/bus GB/s, joined against the strategy's
@@ -23,7 +25,11 @@ Every training script emits the same three artifacts under
 
 ``scripts/report.py`` reads these back for the cross-run side-by-side
 table and regression deltas — the ICI half of the NCCL-vs-ICI
-comparison in BASELINE.md.
+comparison in BASELINE.md.  ``scripts/runs.py`` indexes whole results
+trees into a queryable sqlite registry (and folds ledger aggregates
+into the autotuner's ``cost_model.json``), and :mod:`.metrics` adds the
+live side: a :class:`MetricsRegistry` scrapeable over HTTP while the
+run is still going.
 """
 
 from .schema import (  # noqa: F401
@@ -34,7 +40,19 @@ from .schema import (  # noqa: F401
 )
 from .manifest import RunManifest  # noqa: F401
 from .writer import MetricsWriter  # noqa: F401
-from .spans import SpanStream, maybe_span, read_spans  # noqa: F401
+from .spans import (  # noqa: F401
+    SpanStream,
+    maybe_span,
+    read_clock_anchor,
+    read_spans,
+)
+from .metrics import (  # noqa: F401
+    MetricsRegistry,
+    MetricsServer,
+    maybe_inc,
+    maybe_observe,
+    maybe_set,
+)
 from .ledger import (  # noqa: F401
     CollectiveLedger,
     LedgerEntry,

@@ -65,6 +65,9 @@ class RunManifest:
     local_device_count: int = 0
     process_index: int = 0
     process_count: int = 1
+    # os pid of the emitting worker — with extra["rank"] this keys the
+    # cross-rank merge (fleet_timeline) back to a concrete process
+    pid: int | None = None
     platform: str = ""
     jax_version: str = ""
     jaxlib_version: str | None = None
@@ -117,6 +120,7 @@ class RunManifest:
             local_device_count=len(jax.local_devices()),
             process_index=jax.process_index(),
             process_count=jax.process_count(),
+            pid=os.getpid(),
             platform=dev.platform,
             jax_version=jax.__version__,
             jaxlib_version=jaxlib_version,

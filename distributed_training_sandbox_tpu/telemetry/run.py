@@ -3,9 +3,15 @@
 Glues the pieces together around the step loop:
 
   * captures and writes the :class:`RunManifest` at entry;
-  * appends one ``schema.step_event`` per optimizer step (rank-0 only),
-    timing steps host-side and lifting rates from the
-    ``PerformanceTracker`` metrics dict the scripts already compute;
+  * appends one ``schema.step_event`` per optimizer step — every rank
+    emits, rank > 0 under a ``-r<rank>`` run-id suffix so
+    ``scripts/fleet_timeline.py`` can merge a launch group — timing
+    steps host-side and lifting rates from the ``PerformanceTracker``
+    metrics dict the scripts already compute;
+  * owns the live :class:`~.metrics.MetricsRegistry` (fed by the pump,
+    prefetcher, checkpointer, batcher, router and heartbeats) and, when
+    ``--metrics-port`` is set, its Prometheus scrape endpoint plus
+    periodic ``metrics.jsonl`` snapshots;
   * owns the ``Profiler`` lifecycle — ``step()`` advances it and
     ``__exit__`` stops it on *every* path, so an exception mid-loop
     still flushes the in-flight ``jax.profiler`` trace (the reference
@@ -57,7 +63,9 @@ class TelemetryRun:
                  extra: dict | None = None,
                  results_dir: str | None = None,
                  run_name: str | None = None,
-                 profiler=None, enabled: bool | None = None):
+                 profiler=None, enabled: bool | None = None,
+                 metrics_port: int | None = None,
+                 metrics_snapshot_s: float = 10.0):
         import jax
         self.strategy = strategy
         self.config = config
@@ -75,12 +83,32 @@ class TelemetryRun:
             run_name = getattr(config, "run_name", None)
         want = getattr(config, "telemetry", True) if enabled is None \
             else enabled
-        # telemetry artifacts are rank-0-only; profiler ownership is not
-        self.enabled = bool(want) and jax.process_index() == 0
+        # every rank emits its own artifacts (rank > 0 under a
+        # ``-r<rank>`` run-id suffix) so scripts/fleet_timeline.py can
+        # merge a launch group; DTS_PROCESS_ID wins over
+        # jax.process_index() so launcher-spawned workers that never
+        # initialize jax.distributed still stamp their true rank
+        env_rank = os.environ.get("DTS_PROCESS_ID")
+        self.rank = int(env_rank) if env_rank else jax.process_index()
+        self.enabled = bool(want)
         self.results_dir = results_dir
-        self.run_id = self._unique_run_id(results_dir, strategy, run_name)
+        self.run_id = self._unique_run_id(results_dir, strategy, run_name,
+                                          rank=self.rank)
         self.run_dir = os.path.join(results_dir, self.run_id) \
             if self.enabled else None
+        # live metrics: registry always present while enabled (feed
+        # sites are None-guarded), HTTP endpoint only on request
+        if metrics_port is None:
+            metrics_port = getattr(config, "metrics_port", None)
+        self._metrics_port = metrics_port
+        self.metrics_snapshot_s = float(metrics_snapshot_s)
+        self.metrics = None
+        self.metrics_server = None
+        self._t_metrics_snapshot: float | None = None
+        self._metrics_snapshots = 0
+        if self.enabled:
+            from .metrics import MetricsRegistry
+            self.metrics = MetricsRegistry()
         self.writer: MetricsWriter | None = None
         self.manifest: RunManifest | None = None
         self._step_idx = 0
@@ -105,9 +133,13 @@ class TelemetryRun:
 
     @staticmethod
     def _unique_run_id(results_dir: str, strategy: str,
-                       run_name: str | None) -> str:
+                       run_name: str | None, rank: int = 0) -> str:
         label = strategy if not run_name else f"{strategy}-{run_name}"
         rid = build_run_id(label)
+        if rank:
+            # rank-suffixed so N ranks of one launch group land as N
+            # sibling run dirs (merged by scripts/fleet_timeline.py)
+            rid = f"{rid}-r{rank}"
         # second-resolution timestamps collide when two runs start in the
         # same second (the test suite does exactly that)
         n, base = 2, rid
@@ -119,17 +151,29 @@ class TelemetryRun:
     # ---- lifecycle ------------------------------------------------------
     def start(self) -> "TelemetryRun":
         if self.enabled:
+            extra = dict(self.extra or {})
+            extra.setdefault("rank", self.rank)
+            group = os.environ.get("DTS_LAUNCH_GROUP")
+            if group:
+                # launcher-stamped group id: fleet_timeline groups the
+                # per-rank run dirs of one `dts-launch run` by this key
+                extra.setdefault("launch_group", group)
             self.manifest = RunManifest.capture(
                 self.strategy, run_id=self.run_id, config=self.config,
                 mesh=self.mesh, model=self.model,
                 collective_counts=self.collective_counts,
                 contract=self.contract,
                 lineage=self.lineage,
-                extra=self.extra)
+                extra=extra)
             self.writer = MetricsWriter(self.run_dir)
             self.writer.write_manifest(self.manifest)
             from .spans import SpanStream
             self.spans = SpanStream(self.run_dir)
+            if self._metrics_port is not None:
+                from .metrics import MetricsServer
+                self.metrics_server = MetricsServer(
+                    self.metrics, port=int(self._metrics_port)).start()
+                self._t_metrics_snapshot = time.perf_counter()
         self._t_prev = time.perf_counter()
         return self
 
@@ -192,6 +236,14 @@ class TelemetryRun:
             self.profiler.step()
         tm = tracker_metrics or {}
         step_time = tm.get("last_step_time_s") or dt
+        extra.setdefault("rank", self.rank)
+        if self.metrics is not None:
+            self.metrics.inc("steps_total")
+            if tokens:
+                self.metrics.inc("tokens_total", int(tokens))
+            if step_time is not None:
+                self.metrics.set("last_step_time_s", float(step_time))
+            self._maybe_snapshot_metrics(now)
         deferred = loss is not None and hasattr(loss, "block_until_ready")
         if step_time is not None:
             self._step_times.append(float(step_time))
@@ -215,6 +267,24 @@ class TelemetryRun:
             self.writer.append_step(step_event(
                 idx, loss=loss, tokens=tokens, step_time_s=step_time,
                 tracker_metrics=tracker_metrics, **extra))
+
+    def _maybe_snapshot_metrics(self, now: float) -> None:
+        """Append a timestamped line to ``metrics.jsonl`` every
+        ``metrics_snapshot_s`` while the endpoint is live (snapshots and
+        endpoint are one feature: runs that never asked for live
+        metrics keep their exact artifact set)."""
+        if self.metrics_server is None or self.run_dir is None \
+                or self._t_metrics_snapshot is None:
+            return
+        if now - self._t_metrics_snapshot < self.metrics_snapshot_s:
+            return
+        self._t_metrics_snapshot = now
+        try:
+            self.metrics.write_snapshot(
+                os.path.join(self.run_dir, "metrics.jsonl"))
+            self._metrics_snapshots += 1
+        except OSError:
+            pass
 
     def flush(self, up_to: int | None = None) -> None:
         """Resolve buffered deferred-loss events (oldest first; all of
@@ -338,6 +408,18 @@ class TelemetryRun:
             self.spans.close()
             if self.spans.spans_written:
                 summary["spans_recorded"] = self.spans.spans_written
+        if self.metrics is not None and self.metrics:
+            # final counter values — the live endpoint's last scrape and
+            # this block must agree (pinned by test_obsplane)
+            summary["metrics"] = self.metrics.snapshot()
+        if self.metrics_server is not None:
+            try:
+                self.metrics.write_snapshot(
+                    os.path.join(self.run_dir, "metrics.jsonl"))
+            except OSError:
+                pass
+            self.metrics_server.stop()
+            self.metrics_server = None
         self.writer.write_summary(summary)
         self.writer.close()
         return summary

@@ -28,9 +28,21 @@ burst time:
   ``pool_util``        granted / usable pages (0..1)
   ``ttft_ms``          this request's time-to-first-token (prefill)
   ``completed_requests`` per-request {rid, ttft_ms, per_token_ms,
-                       tokens} retired at this burst's sync point
+                       tokens, trace_id} retired at this burst's sync
+                       point
   ``replica``          fleet replica index that emitted the event
                        (absent on single-engine runs)
+  ``request_id``       engine-local request id for per-request events
+                       (prefill completions); optional, additive
+  ``trace_id``         distributed trace id minted at Router.submit —
+                       stable across failover replay, joins an event to
+                       its request swimlane; optional, additive
+  ``rank``             emitting process rank (``DTS_PROCESS_ID``);
+                       optional, stamped on multi-process runs
+
+The ``request_id`` / ``trace_id`` / ``rank`` fields are additive and
+optional — the schema version is unchanged and pre-existing reports
+parse events that carry them without modification.
 """
 
 from __future__ import annotations
@@ -59,6 +71,10 @@ STEP_FIELDS = {
     "pool_util": False,
     "ttft_ms": False,
     "completed_requests": False,
+    # distributed-tracing extras (optional, schema version unchanged)
+    "request_id": False,
+    "trace_id": False,
+    "rank": False,
 }
 
 

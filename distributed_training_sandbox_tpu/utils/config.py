@@ -45,6 +45,9 @@ class TrainConfig:
     profile: bool = True
     results_dir: str = field(default_factory=default_results_dir)
     telemetry: bool = True
+    # live Prometheus scrape endpoint (telemetry.metrics): None = off,
+    # 0 = ephemeral port (tests), >0 = fixed port
+    metrics_port: int | None = None
     # --- async step pump (runtime/) --------------------------------------
     # dispatch: "async" = bounded in-flight dispatch, losses retired as
     # device arrays, host blocks only at the sync policy points;
@@ -159,6 +162,11 @@ def build_argparser(parser: argparse.ArgumentParser | None = None):
                    action="store_false", default=None,
                    help="disable the manifest/steps.jsonl/summary.json "
                         "run artifacts")
+    p.add_argument("--metrics-port", dest="metrics_port", type=int,
+                   default=None,
+                   help="serve live Prometheus metrics on this port "
+                        "while the run is going (0 = ephemeral port; "
+                        "also writes periodic metrics.jsonl snapshots)")
     p.add_argument("--dispatch", dest="dispatch",
                    choices=["async", "sync"], default=None,
                    help="step pump mode: bounded async dispatch (default) "

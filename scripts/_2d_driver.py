@@ -171,6 +171,7 @@ def _leg(mode, args, rest, cfg, ctx):
             lineage=ctx.manifest_lineage(),
             extra={mode: second}) as telem:
         pref.spans = telem.spans   # prefetch waits onto the timeline
+        pref.metrics = telem.metrics
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight) as pump:

@@ -60,8 +60,9 @@ def _time_steps(step_fn, state, batch, n_steps, telem=None, label="",
     if ctx is not None and getattr(ctx, "ckptr", None) is not None \
             and telem is not None:
         # checkpoint saves show up as checkpoint/save spans on the
-        # run's merged host timeline
+        # run's merged host timeline (and as live counters)
         ctx.ckptr.spans = telem.spans
+        ctx.ckptr.metrics = telem.metrics
     if telem is not None:
         # ledger join: compiled text at the loop's exact arg shardings
         # (this driver reuses one fixed batch for every step)

@@ -186,6 +186,7 @@ def _mlp_leg(args, cfg, ctx):
                             lineage=ctx.manifest_lineage(),
                             profiler=prof) as telem:
         pref.spans = telem.spans   # prefetch waits onto the timeline
+        pref.metrics = telem.metrics
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight,
@@ -342,6 +343,7 @@ def _classification_leg(args, cfg, ctx):
                             lineage=ctx.manifest_lineage(),
                             profiler=prof) as telem:
         pref.spans = telem.spans   # prefetch waits onto the timeline
+        pref.metrics = telem.metrics
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight,

@@ -121,9 +121,15 @@ def build_timeline(run_dir: str) -> dict:
     if trace_file:
         device = load_device_events(trace_file)
         _rebase(device)
+    # metadata (track-naming ph="M") events first, then everything in
+    # timestamp order — some viewers resolve track names lazily and
+    # mis-group out-of-order streams
+    merged = sorted(device + host,
+                    key=lambda e: (0 if e.get("ph") == "M" else 1,
+                                   e.get("ts", 0.0)))
     return {
         "displayTimeUnit": "ms",
-        "traceEvents": device + host,
+        "traceEvents": merged,
         "metadata": {
             "run_dir": os.path.abspath(run_dir),
             "host_spans": len(spans),

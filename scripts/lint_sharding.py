@@ -202,6 +202,21 @@ def main(argv=None) -> int:
         if serving_dir.is_dir():
             findings += lint_tree(serving_dir, recursive=True,
                                   checks={"host-sync-in-loop"})
+        # the launcher tree joins the swallowed-error sweep: a silently
+        # eaten exception in process supervision is how a dead worker
+        # goes unnoticed until the collective wedges
+        launch_dir = pkg_dir / "launch"
+        if launch_dir.is_dir():
+            findings += lint_tree(launch_dir, recursive=True,
+                                  checks={"swallowed-distributed-error",
+                                          "host-sync-in-loop"})
+        # every tree that EMITS telemetry gets the cardinality lint:
+        # span/metric names must be static strings at the call site
+        for sub in ("telemetry", "runtime", "serving"):
+            d = pkg_dir / sub
+            if d.is_dir():
+                findings += lint_tree(d, recursive=True,
+                                      checks={"span-name-not-static"})
         report["pitfalls"] = [f.to_dict() for f in findings]
         errors = [f for f in findings if f.severity == "error"]
         for f in findings:

@@ -1,0 +1,450 @@
+"""Queryable run registry: index telemetry run dirs into a sqlite
+database and answer the questions artifact-grepping can't —
+
+  * ``index``   — walk a results root, upsert every run's manifest +
+    summary + collective-ledger aggregates into ``runs.sqlite``
+  * ``list``    — tabulate indexed runs (filter by strategy/model/group)
+  * ``show``    — one run's summary metrics + per-collective bandwidth
+  * ``diff``    — regression deltas between two runs: throughput, step
+    time, host syncs, loss, and per-(kind, bucket, axis) busbw
+  * ``export-cost-model`` — fold ledger aggregates across >= N indexed
+    runs into ``cost_model.json``: the measured bus bandwidth per
+    (collective kind, payload bucket, mesh axis) an autotuner can use
+    as its communication cost table.  ``load_cost_model`` round-trips
+    it back for consumers.
+
+The database is disposable — ``index`` rebuilds rows from the run-dir
+artifacts, which remain the source of truth.
+
+  python scripts/runs.py index --results-dir runs
+  python scripts/runs.py list
+  python scripts/runs.py diff RUN_A RUN_B
+  python scripts/runs.py export-cost-model --out cost_model.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sqlite3
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+DB_FILENAME = "runs.sqlite"
+COST_MODEL_SCHEMA = 1
+
+# summary metrics surfaced as real columns (everything else stays in
+# the summary_json blob); sign says which direction is an improvement
+# for ``diff``: +1 higher-is-better, -1 lower-is-better
+_METRICS = {
+    "steps_recorded": +1,
+    "total_tokens": +1,
+    "tokens_per_second": +1,
+    "step_time_ms": -1,
+    "final_loss": -1,
+    "host_sync_count": -1,
+}
+
+_SCHEMA_SQL = """
+CREATE TABLE IF NOT EXISTS runs (
+    run_id        TEXT PRIMARY KEY,
+    run_dir       TEXT NOT NULL,
+    strategy      TEXT,
+    model         TEXT,
+    status        TEXT,
+    launch_group  TEXT,
+    rank          INTEGER,
+    started_utc   TEXT,
+    device_count  INTEGER,
+    steps_recorded   REAL,
+    total_tokens     REAL,
+    tokens_per_second REAL,
+    step_time_ms     REAL,
+    final_loss       REAL,
+    host_sync_count  REAL,
+    summary_json  TEXT
+);
+CREATE TABLE IF NOT EXISTS ledger_aggregates (
+    run_id         TEXT NOT NULL,
+    kind           TEXT NOT NULL,
+    payload_bucket TEXT NOT NULL,
+    axis           TEXT NOT NULL,
+    sites          INTEGER,
+    events         INTEGER,
+    total_us       REAL,
+    bytes_moved    REAL,
+    bus_bytes_moved REAL,
+    algbw_gbps     REAL,
+    busbw_gbps     REAL,
+    PRIMARY KEY (run_id, kind, payload_bucket, axis)
+);
+"""
+
+
+def connect(db_path: str) -> sqlite3.Connection:
+    conn = sqlite3.connect(db_path)
+    conn.row_factory = sqlite3.Row
+    conn.executescript(_SCHEMA_SQL)
+    return conn
+
+
+def _load_json(path: Path) -> dict | None:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+# ------------------------------------------------------------------ index
+
+def index_run_dir(conn: sqlite3.Connection, run_dir: str) -> str | None:
+    """Upsert one run dir; returns the run_id, or None if the dir has
+    no readable manifest (not a telemetry run)."""
+    d = Path(run_dir)
+    man = _load_json(d / "manifest.json")
+    if man is None:
+        return None
+    summary = _load_json(d / "summary.json") or {}
+    run_id = man.get("run_id") or d.name
+    extra = man.get("extra") or {}
+    row = {
+        "run_id": run_id,
+        "run_dir": str(d),
+        "strategy": man.get("strategy"),
+        "model": man.get("model"),
+        "status": summary.get("status", "running"),
+        "launch_group": extra.get("launch_group"),
+        "rank": extra.get("rank", man.get("process_index", 0)),
+        "started_utc": man.get("started_utc"),
+        "device_count": man.get("device_count"),
+        "summary_json": json.dumps(summary),
+    }
+    for m in _METRICS:
+        row[m] = summary.get(m)
+    cols = ", ".join(row)
+    ph = ", ".join(f":{k}" for k in row)
+    conn.execute(
+        f"INSERT OR REPLACE INTO runs ({cols}) VALUES ({ph})", row)
+    conn.execute("DELETE FROM ledger_aggregates WHERE run_id = ?",
+                 (run_id,))
+    ledger = _load_json(d / "collectives.json") or {}
+    for agg in (ledger.get("aggregates") or {}).values():
+        conn.execute(
+            "INSERT OR REPLACE INTO ledger_aggregates VALUES "
+            "(?,?,?,?,?,?,?,?,?,?,?)",
+            (run_id, agg["kind"], agg["payload_bucket"], agg["axis"],
+             agg.get("sites"), agg.get("events"), agg.get("total_us"),
+             agg.get("bytes_moved"), agg.get("bus_bytes_moved"),
+             agg.get("algbw_gbps"), agg.get("busbw_gbps")))
+    conn.commit()
+    return run_id
+
+
+def index_results_dir(conn: sqlite3.Connection,
+                      results_dir: str) -> list[str]:
+    indexed = []
+    root = Path(results_dir)
+    if not root.is_dir():
+        return indexed
+    for entry in sorted(root.iterdir()):
+        if entry.is_dir():
+            rid = index_run_dir(conn, str(entry))
+            if rid is not None:
+                indexed.append(rid)
+    return indexed
+
+
+# ------------------------------------------------------------------ query
+
+def _fetch_run(conn: sqlite3.Connection, run_id: str) -> sqlite3.Row:
+    row = conn.execute("SELECT * FROM runs WHERE run_id = ?",
+                       (run_id,)).fetchone()
+    if row is None:
+        raise KeyError(f"run {run_id!r} not indexed; run "
+                       f"`runs.py index` first")
+    return row
+
+
+def diff_runs(conn: sqlite3.Connection, run_a: str,
+              run_b: str) -> dict:
+    """Regression deltas ``run_b - run_a`` (a = baseline).  Each metric
+    row carries the delta, the percentage, and a verdict sign:
+    improved / regressed / flat by the metric's better-direction."""
+    a, b = _fetch_run(conn, run_a), _fetch_run(conn, run_b)
+    metrics = {}
+    for m, better in _METRICS.items():
+        va, vb = a[m], b[m]
+        if va is None or vb is None:
+            continue
+        delta = vb - va
+        pct = (delta / va * 100.0) if va else None
+        verdict = "flat"
+        if abs(delta) > 1e-12:
+            verdict = "improved" if delta * better > 0 else "regressed"
+        metrics[m] = {"baseline": va, "current": vb,
+                      "delta": round(delta, 6),
+                      "pct": round(pct, 3) if pct is not None else None,
+                      "verdict": verdict}
+    # per-collective busbw deltas where both runs measured the key
+    rows = conn.execute(
+        "SELECT a.kind, a.payload_bucket, a.axis, "
+        "       a.busbw_gbps AS base, b.busbw_gbps AS cur "
+        "FROM ledger_aggregates a JOIN ledger_aggregates b "
+        "  ON a.kind = b.kind AND a.payload_bucket = b.payload_bucket "
+        " AND a.axis = b.axis "
+        "WHERE a.run_id = ? AND b.run_id = ?", (run_a, run_b))
+    busbw = {}
+    for r in rows:
+        key = f"{r['kind']}|{r['payload_bucket']}|{r['axis']}"
+        delta = (r["cur"] or 0.0) - (r["base"] or 0.0)
+        busbw[key] = {"baseline_gbps": r["base"],
+                      "current_gbps": r["cur"],
+                      "delta_gbps": round(delta, 4)}
+    return {"baseline": run_a, "current": run_b,
+            "metrics": metrics, "busbw": busbw}
+
+
+# ------------------------------------------------------------- cost model
+
+def export_cost_model(conn: sqlite3.Connection,
+                      run_ids: list[str] | None = None,
+                      min_runs: int = 3) -> dict:
+    """Fold ledger aggregates across indexed runs into the autotuner's
+    communication cost table.  Pooling is time-weighted (total bus
+    bytes over total time), matching the ledger's own aggregation —
+    NOT a mean of per-run bandwidths, which would overweight short
+    runs.  Requires >= ``min_runs`` distinct contributing runs so one
+    noisy run can't become the cost model."""
+    where, params = "", []
+    if run_ids:
+        where = ("WHERE run_id IN (%s)"
+                 % ",".join("?" * len(run_ids)))
+        params = list(run_ids)
+    rows = conn.execute(
+        f"SELECT * FROM ledger_aggregates {where}", params).fetchall()
+    contributing = sorted({r["run_id"] for r in rows})
+    if len(contributing) < min_runs:
+        raise ValueError(
+            f"cost model needs >= {min_runs} runs with ledger "
+            f"aggregates; have {len(contributing)}: {contributing}")
+    entries: dict[str, dict] = {}
+    for r in rows:
+        key = f"{r['kind']}|{r['payload_bucket']}|{r['axis']}"
+        e = entries.setdefault(key, {
+            "kind": r["kind"], "payload_bucket": r["payload_bucket"],
+            "axis": r["axis"], "runs": 0, "events": 0,
+            "total_us": 0.0, "bytes_moved": 0.0,
+            "bus_bytes_moved": 0.0})
+        e["runs"] += 1
+        e["events"] += r["events"] or 0
+        e["total_us"] += r["total_us"] or 0.0
+        e["bytes_moved"] += r["bytes_moved"] or 0.0
+        e["bus_bytes_moved"] += r["bus_bytes_moved"] or 0.0
+    for e in entries.values():
+        t = e["total_us"]
+        e["algbw_gbps"] = round(e["bytes_moved"] / t / 1e3, 4) \
+            if t else 0.0
+        e["busbw_gbps"] = round(e["bus_bytes_moved"] / t / 1e3, 4) \
+            if t else 0.0
+        e["total_us"] = round(e["total_us"], 3)
+        e["bus_bytes_moved"] = round(e["bus_bytes_moved"], 1)
+    return {
+        "schema": COST_MODEL_SCHEMA,
+        "runs": contributing,
+        "n_runs": len(contributing),
+        "entries": entries,
+    }
+
+
+class CostModel:
+    """Loaded ``cost_model.json``: measured bus bandwidth per
+    (collective kind, payload bucket, mesh axis)."""
+
+    def __init__(self, doc: dict):
+        if doc.get("schema") != COST_MODEL_SCHEMA:
+            raise ValueError(
+                f"cost model schema {doc.get('schema')!r} != "
+                f"{COST_MODEL_SCHEMA}")
+        self.doc = doc
+        self.entries: dict[str, dict] = doc["entries"]
+        self.runs: list[str] = list(doc.get("runs", []))
+
+    def busbw_gbps(self, kind: str, payload_bucket: str,
+                   axis: str) -> float | None:
+        e = self.entries.get(f"{kind}|{payload_bucket}|{axis}")
+        return None if e is None else e["busbw_gbps"]
+
+    def estimate_us(self, kind: str, nbytes: int,
+                    axis: str) -> float | None:
+        """Predicted wall time for one event: the autotuner-facing
+        query (bucket resolved from the byte count)."""
+        from distributed_training_sandbox_tpu.telemetry.ledger import (
+            payload_bucket)
+        bw = self.busbw_gbps(kind, payload_bucket(nbytes), axis)
+        if not bw:
+            return None
+        return nbytes / (bw * 1e3)   # GB/s == bytes/us / 1e3
+
+
+def load_cost_model(path: str) -> CostModel:
+    with open(path) as f:
+        return CostModel(json.load(f))
+
+
+# -------------------------------------------------------------------- cli
+
+def _cmd_index(conn, args) -> int:
+    ids = index_results_dir(conn, args.results_dir)
+    for d in args.run_dirs:
+        rid = index_run_dir(conn, d)
+        if rid is not None:
+            ids.append(rid)
+    print(f"[runs] indexed {len(ids)} run(s) into {args.db}")
+    return 0
+
+
+def _cmd_list(conn, args) -> int:
+    q = "SELECT * FROM runs WHERE 1=1"
+    params: list = []
+    for col in ("strategy", "model", "launch_group"):
+        val = getattr(args, col.replace("launch_group", "group"))
+        if val:
+            q += f" AND {col} = ?"
+            params.append(val)
+    q += " ORDER BY started_utc, run_id"
+    rows = conn.execute(q, params).fetchall()
+    hdr = (f"{'run_id':32} {'strategy':10} {'status':10} "
+           f"{'steps':>6} {'step_ms':>9} {'tok/s':>12} {'group'}")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in rows:
+        print(f"{r['run_id']:32} {str(r['strategy']):10} "
+              f"{str(r['status']):10} "
+              f"{_fmt(r['steps_recorded'], 0):>6} "
+              f"{_fmt(r['step_time_ms'], 2):>9} "
+              f"{_fmt(r['tokens_per_second'], 0):>12} "
+              f"{r['launch_group'] or '-'}")
+    print(f"[runs] {len(rows)} run(s)")
+    return 0
+
+
+def _fmt(v, nd) -> str:
+    return "-" if v is None else f"{v:.{nd}f}"
+
+
+def _cmd_show(conn, args) -> int:
+    row = _fetch_run(conn, args.run_id)
+    summary = json.loads(row["summary_json"] or "{}")
+    print(f"[runs] {row['run_id']}  ({row['run_dir']})")
+    for col in ("strategy", "model", "status", "launch_group", "rank",
+                "started_utc", "device_count"):
+        print(f"  {col:18} {row[col]}")
+    for m in sorted(summary):
+        v = summary[m]
+        if isinstance(v, (int, float, str)):
+            print(f"  {m:18} {v}")
+    aggs = conn.execute(
+        "SELECT * FROM ledger_aggregates WHERE run_id = ? "
+        "ORDER BY kind, payload_bucket, axis",
+        (args.run_id,)).fetchall()
+    if aggs:
+        print("  collective aggregates:")
+        for a in aggs:
+            print(f"    {a['kind']:22} {a['payload_bucket']:8} "
+                  f"axis={a['axis']:10} busbw={a['busbw_gbps']} GB/s "
+                  f"({a['events']} events, {a['total_us']:.0f} us)")
+    return 0
+
+
+def _cmd_diff(conn, args) -> int:
+    d = diff_runs(conn, args.baseline, args.current)
+    print(f"[runs] {args.current} vs baseline {args.baseline}")
+    for m, row in d["metrics"].items():
+        pct = f" ({row['pct']:+.1f}%)" if row["pct"] is not None else ""
+        print(f"  {m:18} {row['baseline']} -> {row['current']} "
+              f"[{row['verdict']}{pct}]")
+    for key, row in d["busbw"].items():
+        print(f"  busbw {key:34} {row['baseline_gbps']} -> "
+              f"{row['current_gbps']} GB/s "
+              f"({row['delta_gbps']:+.3f})")
+    if args.json:
+        print(json.dumps(d, indent=2))
+    regressed = [m for m, row in d["metrics"].items()
+                 if row["verdict"] == "regressed"]
+    return 1 if (args.fail_on_regression and regressed) else 0
+
+
+def _cmd_export(conn, args) -> int:
+    try:
+        model = export_cost_model(conn, args.run_ids or None,
+                                  min_runs=args.min_runs)
+    except ValueError as e:
+        print(f"[runs] {e}", file=sys.stderr)
+        return 2
+    with open(args.out, "w") as f:
+        json.dump(model, f, indent=2)
+        f.write("\n")
+    print(f"[runs] cost model from {model['n_runs']} run(s), "
+          f"{len(model['entries'])} (kind, bucket, axis) entr(ies) "
+          f"-> {args.out}")
+    for key, e in sorted(model["entries"].items()):
+        print(f"  {key:44} busbw={e['busbw_gbps']} GB/s "
+              f"over {e['runs']} run(s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="index + query telemetry run dirs")
+    p.add_argument("--db", type=str, default=DB_FILENAME,
+                   help=f"sqlite path (default ./{DB_FILENAME})")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("index", help="index run dirs into the db")
+    s.add_argument("run_dirs", nargs="*",
+                   help="individual run dirs to index")
+    s.add_argument("--results-dir", type=str, default="runs",
+                   help="walk this root for run dirs (default: runs)")
+
+    s = sub.add_parser("list", help="tabulate indexed runs")
+    s.add_argument("--strategy", type=str, default=None)
+    s.add_argument("--model", type=str, default=None)
+    s.add_argument("--group", type=str, default=None,
+                   help="filter by launch_group")
+
+    s = sub.add_parser("show", help="one run's metrics + ledger")
+    s.add_argument("run_id")
+
+    s = sub.add_parser("diff", help="regression deltas: current vs "
+                                    "baseline")
+    s.add_argument("baseline")
+    s.add_argument("current")
+    s.add_argument("--json", action="store_true",
+                   help="also dump the machine-readable diff")
+    s.add_argument("--fail-on-regression", action="store_true",
+                   help="exit 1 if any metric regressed")
+
+    s = sub.add_parser("export-cost-model",
+                       help="fold ledger aggregates across runs into "
+                            "cost_model.json")
+    s.add_argument("run_ids", nargs="*",
+                   help="restrict to these runs (default: all indexed)")
+    s.add_argument("--out", type=str, default="cost_model.json")
+    s.add_argument("--min-runs", type=int, default=3,
+                   help="minimum distinct contributing runs (default 3)")
+
+    args = p.parse_args(argv)
+    conn = connect(args.db)
+    try:
+        return {"index": _cmd_index, "list": _cmd_list,
+                "show": _cmd_show, "diff": _cmd_diff,
+                "export-cost-model": _cmd_export}[args.cmd](conn, args)
+    finally:
+        conn.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -131,6 +131,10 @@ def main(argv=None) -> int:
     p.add_argument("--swap-ckpt", default=None, metavar="DIR",
                    help="checkpoint directory for --swap-at (default: "
                         "save a seed+1 init to a temp dir)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve live Prometheus metrics on this port "
+                        "for the run's duration (0 = ephemeral port, "
+                        "printed at start; default off)")
     p.add_argument("--watchdog-timeout", type=float, default=5.0,
                    help="per-replica decode watchdog budget, seconds "
                         "(converts a wedged burst into failover)")
@@ -207,7 +211,11 @@ def main(argv=None) -> int:
                                                  warmup=2, active=8))
     failures = []
     with TelemetryRun("serving", model=args.model, mesh=mesh,
-                      config=run_cfg, profiler=prof) as telem:
+                      config=run_cfg, profiler=prof,
+                      metrics_port=args.metrics_port) as telem:
+        if telem.metrics_server is not None:
+            print(f"[serve] metrics: {telem.metrics_server.url}",
+                  flush=True)
         eng = ServingEngine(
             params, cfg, mesh=mesh, max_batch=args.max_batch,
             page_size=args.page_size, max_seq_len=args.max_seq_len,
@@ -334,7 +342,11 @@ def _fleet_main(args) -> int:
                                                  warmup=2, active=8))
     failures = []
     with TelemetryRun("fleet", model=args.model, config=run_cfg,
-                      profiler=prof) as telem:
+                      profiler=prof,
+                      metrics_port=args.metrics_port) as telem:
+        if telem.metrics_server is not None:
+            print(f"[serve] metrics: {telem.metrics_server.url}",
+                  flush=True)
         fleet = Fleet(
             params, cfg, replicas=args.replicas,
             watchdog_timeout_s=args.watchdog_timeout,

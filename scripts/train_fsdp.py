@@ -272,6 +272,7 @@ def _leg(args, rest, cfg, ctx):
                    "reshard_after_forward": args.reshard,
                    "memory_plan": mem_record}) as telem:
         pref.spans = telem.spans   # prefetch waits onto the timeline
+        pref.metrics = telem.metrics
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight) as pump:

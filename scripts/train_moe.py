@@ -164,6 +164,7 @@ def _leg(args, rest, cfg, ctx):
             extra={"experts": args.experts, "ep": args.ep,
                    "top_k": args.top_k}) as telem:
         pref.spans = telem.spans   # prefetch waits onto the timeline
+        pref.metrics = telem.metrics
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
                       sync_every=cfg.sync_every,
                       max_in_flight=cfg.max_in_flight) as pump:
