@@ -48,6 +48,10 @@ def _build_config(args) -> LaunchConfig:
         cfg.group_restarts = args.group_restarts
     if getattr(args, "heartbeat_timeout", None) is not None:
         cfg.heartbeat_timeout = args.heartbeat_timeout
+    if getattr(args, "distributed", None):
+        cfg.distributed = True
+    if getattr(args, "bringup_timeout", None) is not None:
+        cfg.bringup_timeout = args.bringup_timeout
     return cfg
 
 
@@ -69,6 +73,15 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--nprocs", type=int, default=None,
                         help="worker processes (torchrun --nproc_per_node"
                              " twin); needs a cpu:<k> device spec")
+        sp.add_argument("--distributed", action="store_true", default=None,
+                        help="with --nprocs N: real jax.distributed mode — "
+                             "bounded bring-up with a cross-process "
+                             "barrier, one global mesh spanning all "
+                             "worker processes")
+        sp.add_argument("--bringup-timeout", type=float, default=None,
+                        help="--distributed: seconds allowed for "
+                             "rendezvous + bring-up barrier before a "
+                             "missing peer raises (default 120)")
         sp.add_argument("--elastic", action="store_true", default=None,
                         help="with --nprocs: on worker death, shrink to "
                              "the survivors and relaunch with --resume "

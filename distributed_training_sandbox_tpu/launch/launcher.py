@@ -126,6 +126,14 @@ class LaunchConfig:
     elastic: bool = False
     group_restarts: int = 1
     heartbeat_timeout: float = 10.0
+    #: real-distributed mode (``--distributed``): workers run the
+    #: cross-process bring-up barrier after ``jax.distributed``
+    #: initialize, with ``bringup_timeout`` seconds budget for both the
+    #: rendezvous and the barrier — a missing peer becomes a readable
+    #: BringupTimeout / StepTimeoutError in the worker log instead of a
+    #: group that hangs until the launch timeout.
+    distributed: bool = False
+    bringup_timeout: float = 120.0
 
     @classmethod
     def from_config(cls, config: dict | str | os.PathLike) -> "LaunchConfig":
@@ -165,6 +173,10 @@ class LaunchConfig:
             kw["group_restarts"] = int(devices["group_restarts"])
         if "heartbeat_timeout" in devices:
             kw["heartbeat_timeout"] = float(devices["heartbeat_timeout"])
+        if "distributed" in devices:
+            kw["distributed"] = bool(devices["distributed"])
+        if "bringup_timeout" in devices:
+            kw["bringup_timeout"] = float(devices["bringup_timeout"])
         kw["env"] = dict(launcher.get("env", {}))
         kw["extra_args"] = list(launcher.get("args", []))
         return cls(**kw)
@@ -262,6 +274,9 @@ def run_training(config: LaunchConfig, *, script: str | None = None,
     env.update({k: str(v) for k, v in config.env.items()})
 
     nprocs = int(config.nprocs or 1)
+    if config.distributed and nprocs < 2:
+        raise ValueError("--distributed needs --nprocs >= 2 (one process "
+                         "is not a process group)")
     print(f"[launch] {config.name}: {' '.join(cmd)}"
           + (f" (x{nprocs} processes)" if nprocs > 1 else ""))
     print(f"[launch] TRACE_DIR={trace_dir}")
@@ -316,16 +331,66 @@ def _die_with_parent():
 class GroupResult:
     """Outcome of one worker-group attempt: the propagatable exit code
     (first nonzero worker's, 128+SIG for signal deaths), which ranks
-    failed, and how long detection took from first poll of the dead
-    worker (the bounded-interval contract of the failure detector)."""
+    failed, how long detection took from first poll of the dead worker
+    (the bounded-interval contract of the failure detector), and any
+    worker pids that survived teardown un-reaped (zombies — must be
+    empty before the coordinator may shrink and relaunch)."""
     returncode: int
     failed_ranks: list
     detect_s: float | None = None
+    unreaped: list = field(default_factory=list)
+
+
+def _group_hit_addrinuse(trace_dir: Path, nprocs: int) -> bool:
+    """Did any worker of the last attempt die on a coordinator-port
+    collision?  The launcher bind-probes for a free port, but the probe
+    socket closes before ``jax.distributed`` rebinds it — another
+    process can race into the gap, and the worker-side in-place retry
+    (``setup_distributed``) only cures TIME_WAIT, not a genuinely taken
+    port.  Scanned from the worker logs: the failure happens inside the
+    child."""
+    for pid in range(nprocs):
+        log = trace_dir / f"worker_{pid}.log"
+        try:
+            text = log.read_text()
+        except OSError:
+            continue
+        if "EADDRINUSE" in text or "address already in use" in text.lower():
+            return True
+    return False
 
 
 def _run_worker_group(config: LaunchConfig, cmd: list[str], env: dict,
                       trace_dir: Path, nprocs: int,
                       heartbeat_dir: Path | None = None) -> GroupResult:
+    """Port-rotating wrapper over :func:`_run_worker_group_once`: pick a
+    fresh ephemeral coordinator port (bind-probe, never hardcoded), run
+    the group, and if the attempt died with EADDRINUSE in a worker log,
+    rotate to a NEW port and retry — bounded, so a genuinely broken
+    network surfaces instead of looping."""
+    max_attempts = 3
+    res = None
+    for attempt in range(max_attempts):
+        port = _free_port()
+        coord = f"127.0.0.1:{port}"
+        res = _run_worker_group_once(config, cmd, env, trace_dir, nprocs,
+                                     heartbeat_dir=heartbeat_dir,
+                                     coord=coord)
+        if res.returncode and attempt < max_attempts - 1 \
+                and _group_hit_addrinuse(trace_dir, nprocs):
+            print(f"[launch] coordinator port {port} collided "
+                  f"(EADDRINUSE in worker log); rotating to a fresh "
+                  f"port [{attempt + 1}/{max_attempts - 1}]",
+                  file=sys.stderr)
+            continue
+        break
+    return res
+
+
+def _run_worker_group_once(config: LaunchConfig, cmd: list[str], env: dict,
+                           trace_dir: Path, nprocs: int,
+                           heartbeat_dir: Path | None = None,
+                           coord: str | None = None) -> GroupResult:
     """The torchrun contract: coordinator address + N worker processes,
     each joining one global mesh via the DTS_* env consumed in
     ``utils.mesh.auto_initialize_from_env``.  Requires a ``cpu:K`` device
@@ -352,7 +417,8 @@ def _run_worker_group(config: LaunchConfig, cmd: list[str], env: dict,
             f"nprocs={nprocs} needs a 'cpu:<k>' device spec (got "
             f"{config.device_spec!r}) — multi-process TPU uses one "
             f"process per host with auto topology discovery")
-    coord = f"127.0.0.1:{_free_port()}"
+    if coord is None:
+        coord = f"127.0.0.1:{_free_port()}"
     base_env = {k: v for k, v in env.items()
                 if k not in ("JAX_PLATFORMS", "JAX_NUM_PROCESSES")}
     # keep user XLA_FLAGS; strip only the host-device-count flag that
@@ -379,6 +445,11 @@ def _run_worker_group(config: LaunchConfig, cmd: list[str], env: dict,
                 "DTS_PROCESS_ID": str(pid)}
         if heartbeat_dir is not None:
             wenv["DTS_HEARTBEAT_DIR"] = str(heartbeat_dir)
+        if config.distributed:
+            # real-distributed mode: bounded bring-up + cross-process
+            # barrier in the worker (utils.mesh.auto_initialize_from_env)
+            wenv["DTS_DISTRIBUTED"] = "1"
+            wenv["DTS_BRINGUP_TIMEOUT"] = str(config.bringup_timeout)
         log = (trace_dir / f"worker_{pid}.log").open("w")
         logs.append(log)
         procs.append(subprocess.Popen(
@@ -426,7 +497,10 @@ def _run_worker_group(config: LaunchConfig, cmd: list[str], env: dict,
         raise
     finally:
         # orphan cleanup on EVERY exit path (failure, timeout,
-        # KeyboardInterrupt, coordinator unwinding): kill + reap
+        # KeyboardInterrupt, coordinator unwinding): kill + reap, then
+        # VERIFY the reap — a pid still visible as a zombie after
+        # wait() means teardown lied, and the relaunch would inherit
+        # its coordinator port and device slots
         for p in procs:
             if p.poll() is None:
                 p.kill()
@@ -434,6 +508,11 @@ def _run_worker_group(config: LaunchConfig, cmd: list[str], env: dict,
             p.wait()
         for log in logs:
             log.close()
+        from ..resilience.faults import unreaped_workers
+        unreaped = unreaped_workers(procs)
+        if unreaped:
+            print(f"[launch] ERROR: worker pid(s) {unreaped} not reaped "
+                  f"after group teardown (zombie)", file=sys.stderr)
     w0 = trace_dir / "worker_0.log"
     if w0.exists():
         sys.stdout.write(w0.read_text())
@@ -441,8 +520,10 @@ def _run_worker_group(config: LaunchConfig, cmd: list[str], env: dict,
         if p.returncode:
             print(f"[launch] worker {pid} exit {p.returncode} — see "
                   f"{trace_dir / f'worker_{pid}.log'}", file=sys.stderr)
+    if unreaped and rc == 0:
+        rc = 1
     return GroupResult(returncode=rc, failed_ranks=sorted(set(failed)),
-                       detect_s=detect_s)
+                       detect_s=detect_s, unreaped=unreaped)
 
 
 def _run_multiprocess(config: LaunchConfig, cmd: list[str], env: dict,
@@ -462,8 +543,14 @@ def run_elastic_group(config: LaunchConfig, cmd: list[str], env: dict,
     from ..resilience.elastic import shrink_plan, WorkerLost
     world, attempt = nprocs, 0
     cmd = list(cmd)
+    transitions: list[dict] = []
+    env = dict(env)
     while True:
         hb_dir = Path(trace_dir) / f"heartbeats-{attempt}"
+        if transitions:
+            # survivors stamp the launcher-level shrink into their
+            # checkpoint lineage (supervisor._stamped reads this)
+            env["DTS_MESH_TRANSITIONS"] = json.dumps(transitions)
         res = _run_worker_group(config, cmd, env, Path(trace_dir), world,
                                 heartbeat_dir=hb_dir)
         if res.returncode == 0:
@@ -471,6 +558,14 @@ def run_elastic_group(config: LaunchConfig, cmd: list[str], env: dict,
         if attempt >= config.group_restarts:
             print(f"[launch] elastic: restart budget "
                   f"({config.group_restarts}) exhausted", file=sys.stderr)
+            return res.returncode
+        if res.unreaped:
+            # shrinking over a zombie would relaunch while the dead
+            # worker still pins its pid table entry (and, on a real
+            # host, its device slots) — refuse rather than stack a new
+            # group on top of an un-torn-down one
+            print(f"[launch] elastic: refusing to shrink — worker "
+                  f"pid(s) {res.unreaped} not reaped", file=sys.stderr)
             return res.returncode
         lost = res.failed_ranks or [world - 1]
         try:
@@ -491,6 +586,11 @@ def run_elastic_group(config: LaunchConfig, cmd: list[str], env: dict,
               f"relaunching {plan.old_world} -> {plan.new_world} "
               f"workers with --resume "
               f"[{attempt + 1}/{config.group_restarts}]")
+        transitions.append({
+            "attempt": attempt, "old_world": plan.old_world,
+            "new_world": plan.new_world, "lost": sorted(set(lost)),
+            "detect_s": res.detect_s,
+        })
         world = plan.new_world
         if "--resume" not in cmd:
             cmd.append("--resume")

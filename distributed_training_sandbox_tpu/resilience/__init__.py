@@ -47,6 +47,7 @@ from .faults import (  # noqa: F401
     corrupt_checkpoint,
     parse_fault_spec,
     truncate_checkpoint,
+    unreaped_workers,
 )
 from .supervisor import (  # noqa: F401
     GracefulShutdown,

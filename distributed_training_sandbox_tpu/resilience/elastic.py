@@ -162,15 +162,30 @@ class HeartbeatMonitor:
         self.started = time.time()
         self.directory.mkdir(parents=True, exist_ok=True)
 
+    def _is_stale(self, path: Path) -> bool:
+        """A liveness file written BEFORE this monitor's attempt started
+        belongs to a previous run sharing the directory: a stale ``.hb``
+        must not mask a worker that died before its first beat, and a
+        stale ``.dead`` must not kill a worker that is alive now."""
+        try:
+            return os.path.getmtime(path) < self.started
+        except OSError:
+            return True   # vanished between glob and stat: not evidence
+
     def dead_workers(self, now: float | None = None) -> list[int]:
         now = time.time() if now is None else now
         beats = read_heartbeats(self.directory)
         dead = []
         for rank in range(self.nworkers):
-            if _dead_path(self.directory, rank).exists():
+            dp = _dead_path(self.directory, rank)
+            if dp.exists() and not self._is_stale(dp):
                 dead.append(rank)
                 continue
             beat = beats.get(rank)
+            if beat is not None \
+                    and beat.get("time", self.started) < self.started:
+                # pre-dates this attempt: treat as never-beaten
+                beat = None
             if beat is None:
                 if now - self.started > self.startup_grace_s:
                     dead.append(rank)

@@ -260,6 +260,12 @@ class FaultInjector:
         from .elastic import WorkerLost
         raise WorkerLost([replica], step=burst, trigger="kill_replica")
 
+    @staticmethod
+    def unreaped(procs) -> list[int]:
+        """Alias of :func:`unreaped_workers` on the injector, so fault
+        call sites can verify the kill they caused was fully collected."""
+        return unreaped_workers(procs)
+
     def wants_corrupt_swap(self) -> bool:
         """True exactly once when the configured fault is
         ``corrupt_swap`` — the fleet calls this at swap time and, if
@@ -269,6 +275,34 @@ class FaultInjector:
             return False
         self.fired = True
         return True
+
+
+# ---- reap verification (coordinator side) --------------------------------
+
+def unreaped_workers(procs) -> list[int]:
+    """Pids of spawned workers that are NOT fully collected: either
+    never waited on (``returncode`` unset) or still pinned as a zombie
+    in the kernel process table.  The ``kill_worker`` fault SIGKILLs a
+    real process; before the coordinator may shrink the group and
+    relaunch, the kill must have been *reaped* — a zombie keeps its pid
+    entry (and on a real host its device slots) and would poison the
+    next attempt.  Empty list == clean teardown."""
+    bad = []
+    for p in procs:
+        pid = getattr(p, "pid", None)
+        if getattr(p, "returncode", None) is None:
+            bad.append(pid)
+            continue
+        try:
+            stat = Path(f"/proc/{pid}/stat").read_text()
+        except OSError:
+            continue   # no /proc entry: fully reaped (or non-Linux)
+        # state is the field after the parenthesized comm (which may
+        # itself contain spaces/parens — split on the LAST close-paren)
+        state = stat.rsplit(")", 1)[-1].split()
+        if state and state[0] == "Z":
+            bad.append(pid)
+    return bad
 
 
 # ---- checkpoint tampering (tests + manual debugging) ---------------------

@@ -95,13 +95,62 @@ def _write_meta(directory: str, state: RunState,
     os.replace(tmp, path)   # atomic: a reader sees old-or-new, never torn
 
 
+def _globalize(tree):
+    """Multi-process saves require every array leaf to be GLOBAL: Orbax
+    refuses process-local arrays ("Cannot serialize host local arrays").
+    Params and optimizer state come out of jit already global, but the
+    PRNG root (``set_seed``'s single-device key) and any host-side numpy
+    leaves are local to each process.  Their values are identical on
+    every rank by construction (identically seeded), so replicating them
+    over a mesh of ALL devices is value-preserving.  Single-process this
+    is the identity."""
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return tree
+    from jax.sharding import Mesh, PartitionSpec
+    from ..utils.mesh import host_to_global
+    mesh = Mesh(np.asarray(jax.devices()), ("all",))
+
+    def fix(leaf):
+        if isinstance(leaf, jax.Array) and leaf.is_fully_addressable:
+            return host_to_global(np.asarray(leaf), mesh, PartitionSpec())
+        if isinstance(leaf, np.ndarray):
+            return host_to_global(leaf, mesh, PartitionSpec())
+        return leaf
+
+    return jax.tree.map(fix, tree)
+
+
+def _localize(restored, like):
+    """Inverse of :func:`_globalize` on the restore path: leaves the
+    caller's ``like`` holds process-locally (the PRNG key) come back
+    from a globalized checkpoint as non-addressable global arrays —
+    fold each back to the local replica so downstream code sees the
+    same shape of array it handed in."""
+    import jax
+    import numpy as np
+
+    def fix(r, l):
+        if isinstance(l, jax.Array) and l.is_fully_addressable \
+                and isinstance(r, jax.Array) \
+                and not r.is_fully_addressable:
+            return jax.device_put(np.asarray(r.addressable_data(0)),
+                                  l.sharding)
+        return r
+
+    return jax.tree.map(fix, restored, like)
+
+
 def save_run_state(mgr, state: RunState, *, wait: bool = False,
                    fingerprint: dict | None = None) -> None:
     """Save ``state`` under its step.  ``wait=False`` leaves the disk
     write async (the device->host copy inside Orbax is synchronous, so
     the next train step may donate/overwrite the buffers immediately);
     the sidecar is written right after — by then the data is captured."""
-    C.save_state(mgr, state.step, state.array_tree(), wait=wait)
+    C.save_state(mgr, state.step, _globalize(state.array_tree()),
+                 wait=wait)
     _write_meta(os.fspath(mgr.directory), state, fingerprint)
 
 
@@ -151,8 +200,9 @@ def restore_run_state(mgr, *, like: RunState,
             and like.prng_key is not None:
         tree["prng"] = like.prng_key
     try:
-        restored = _match_commitment(C.restore_state(mgr, like=tree,
-                                                     step=step), tree)
+        restored = _localize(C.restore_state(mgr, like=_globalize(tree),
+                                             step=step), tree)
+        restored = _match_commitment(restored, tree)
     except CheckpointCorruptError:
         raise
     except Exception as e:  # noqa: BLE001 - rewrapped with context

@@ -23,8 +23,10 @@ so the wiring costs the common path nothing.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
+import sys
 import threading
 import time
 
@@ -316,9 +318,19 @@ class ResilienceContext:
             "attempt": self.attempt,
             "segments": list(self._sup.segments) if self._sup else [],
         })
-        transitions = getattr(self._sup, "transitions", None)
+        transitions = list(getattr(self._sup, "transitions", None) or [])
+        raw = os.environ.get("DTS_MESH_TRANSITIONS")
+        if raw:
+            # launcher-level elastic shrinks (real worker loss) arrive
+            # via env — the survivors' in-process supervisor never saw
+            # the transition, only the relaunch
+            try:
+                transitions = json.loads(raw) + transitions
+            except (ValueError, TypeError):
+                print(f"[resilience] ignoring malformed "
+                      f"DTS_MESH_TRANSITIONS: {raw!r}", file=sys.stderr)
         if transitions:
-            state.lineage["mesh_transitions"] = list(transitions)
+            state.lineage["mesh_transitions"] = transitions
         return state
 
     def _record_segment(self, telem, status: str) -> None:

@@ -28,20 +28,34 @@ from typing import Any, Callable, Iterable, Iterator
 
 
 def sharded_put(batch, mesh, spec):
-    """``jax.device_put`` every array leaf of ``batch`` with
+    """Stage every array leaf of ``batch`` onto ``mesh`` under
     ``NamedSharding(mesh, spec)``.  ``spec`` is one ``PartitionSpec``
     applied to all leaves (the batch-dim sharding every strategy here
-    uses), or a pytree of specs matching ``batch``'s structure."""
+    uses), or a pytree of specs matching ``batch``'s structure.
+
+    When the mesh spans processes (real ``--distributed`` launches) the
+    put routes through :func:`~..utils.mesh.process_local_put`, which
+    slices this process's shard out of the host batch and builds the
+    global array via ``jax.make_array_from_process_local_data`` — each
+    worker only ever transfers its own rows.  Single-process this is the
+    classic committed ``jax.device_put``."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from ..utils.mesh import process_local_put
+
     if mesh is None:
         return batch
+
+    def put(a, s):
+        sh = NamedSharding(mesh, s or PartitionSpec())
+        if not sh.is_fully_addressable:
+            return process_local_put(a, mesh, s or PartitionSpec())
+        return jax.device_put(a, sh)
+
     if isinstance(spec, PartitionSpec) or spec is None:
-        sh = NamedSharding(mesh, spec or PartitionSpec())
-        return jax.tree.map(lambda a: jax.device_put(a, sh), batch)
-    return jax.tree.map(
-        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), batch, spec)
+        return jax.tree.map(lambda a: put(a, spec), batch)
+    return jax.tree.map(put, batch, spec)
 
 
 class _End:

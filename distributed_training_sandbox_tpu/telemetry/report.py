@@ -456,6 +456,36 @@ def render_lineage(rows: list[dict]) -> str:
     return "\n".join(out) if out else "_no runs with restart lineage_"
 
 
+def render_chaos(doc: dict) -> str:
+    """Campaign table for one ``chaos_report.json`` (scripts/chaos.py):
+    the (fault x strategy) matrix with per-cell verdicts and, for red
+    cells, which invariant broke."""
+    cells = [c for c in (doc.get("cells") or []) if isinstance(c, dict)]
+    if not cells:
+        return "_no chaos cells in report_"
+    out = [f"| {'cell':24} | {'fault':13} | {'strategy':8} | "
+           f"{'status':6} | {'dur_s':>6} | invariants |",
+           f"|{'-' * 26}|{'-' * 15}|{'-' * 10}|{'-' * 8}|{'-' * 8}|"
+           f"{'-' * 12}|"]
+    for c in cells:
+        inv = c.get("invariants") or {}
+        bad = [k for k, v in inv.items() if not v]
+        mark = "✓ " + f"{len(inv)}/{len(inv)}" if not bad else \
+            "✗ failed: " + ", ".join(bad)
+        dur = c.get("duration_s")
+        out.append(
+            f"| {str(c.get('cell', '?')):24} "
+            f"| {str(c.get('fault', '?')):13} "
+            f"| {str(c.get('strategy', '?')):8} "
+            f"| {str(c.get('status', '?')):6} "
+            f"| {dur if dur is not None else '-':>6} | {mark} |")
+    s = doc.get("summary") or {}
+    out.append(f"\n{s.get('green', '?')}/{s.get('total', '?')} cell(s) "
+               f"green"
+               + (f" — {s.get('red')} RED" if s.get("red") else ""))
+    return "\n".join(out)
+
+
 # ------------------------------------------------------------ regressions
 
 def _match(cur: dict, base: dict) -> bool:

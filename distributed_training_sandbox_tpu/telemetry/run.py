@@ -158,6 +158,13 @@ class TelemetryRun:
                 # launcher-stamped group id: fleet_timeline groups the
                 # per-rank run dirs of one `dts-launch run` by this key
                 extra.setdefault("launch_group", group)
+            coord = os.environ.get("DTS_COORDINATOR")
+            if coord:
+                # the launcher-chosen coordinator address:port — the
+                # fleet-timeline join can tell two groups apart even
+                # when their launch ids collide, and a port-rotation
+                # retry is visible as a changed port across attempts
+                extra.setdefault("coordinator", coord)
             self.manifest = RunManifest.capture(
                 self.strategy, run_id=self.run_id, config=self.config,
                 mesh=self.mesh, model=self.model,

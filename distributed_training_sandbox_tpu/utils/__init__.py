@@ -4,8 +4,12 @@ from .mesh import (  # noqa: F401
     get_mesh,
     register_mesh,
     setup_distributed,
+    shutdown_distributed,
     auto_initialize_from_env,
+    bringup_barrier,
+    BringupTimeout,
     host_to_global,
+    process_local_put,
     local_scalar,
     use_cpu_devices,
 )

@@ -15,9 +15,12 @@ and is what multi-host (DCN) code keys on.
 
 from __future__ import annotations
 
+import atexit
 import math
 import os
 import re
+import socket
+import time
 from typing import Mapping, Sequence
 
 import numpy as np
@@ -26,6 +29,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 _MESHES: dict[str, Mesh] = {}
 DEFAULT_MESH = "default"
+
+
+class BringupTimeout(RuntimeError):
+    """Distributed bring-up did not complete within the budget.
+
+    Raised instead of letting ``jax.distributed.initialize`` hang
+    forever when a peer never shows up (crashed before connecting, or
+    was never launched) — the coordinator-side twin of a gloo connect
+    timeout.  Carries enough context to tell WHICH rendezvous failed."""
+
+    def __init__(self, coordinator: str | None, num_processes: int | None,
+                 process_id: int | None, timeout_s: float, cause: str = ""):
+        self.coordinator = coordinator
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self.timeout_s = timeout_s
+        detail = f": {cause}" if cause else ""
+        super().__init__(
+            f"distributed bring-up timed out after {timeout_s:.0f}s "
+            f"(coordinator={coordinator}, num_processes={num_processes}, "
+            f"process_id={process_id}) — a peer is missing or the "
+            f"coordinator is unreachable{detail}")
 
 
 def use_cpu_devices(n: int = 8) -> None:
@@ -74,6 +99,14 @@ def auto_initialize_from_env() -> bool:
     setup_distributed(coord, num_processes=int(nprocs),
                       process_id=int(os.environ["DTS_PROCESS_ID"]))
     _DTS_INITIALIZED = True
+    barrier = os.environ.get("DTS_BRINGUP_TIMEOUT")
+    if barrier:
+        # --distributed mode: prove every peer actually executes a
+        # collective before the driver starts building state.  A peer
+        # that connected to the coordinator but wedged before its first
+        # psum becomes a StepTimeoutError here — the same exception the
+        # elastic supervisor already knows how to restart from.
+        bringup_barrier(float(barrier))
     return True
 
 
@@ -81,6 +114,8 @@ def setup_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    *,
+    timeout_s: float | None = None,
 ) -> None:
     """Multi-host (DCN) bring-up: twin of ``dist.init_process_group`` at
     reference ``zero/zero1.py:204``.
@@ -88,24 +123,129 @@ def setup_distributed(
     Single-host (the common case here) is a no-op — ICI collectives need no
     process group.  On a multi-host TPU slice JAX auto-detects the topology,
     so all arguments are optional.
+
+    Bring-up is BOUNDED: ``timeout_s`` (default ``DTS_BRINGUP_TIMEOUT``
+    or 120s) caps how long ``jax.distributed.initialize`` may wait for
+    peers — a missing peer raises :class:`BringupTimeout` instead of
+    hanging forever.  A coordinator port still in TIME_WAIT from a
+    previous group (EADDRINUSE) is retried in place a few times before
+    giving up; rotation to a *fresh* port is the launcher's job (it owns
+    port selection).  ``jax.distributed.shutdown`` is registered via
+    ``atexit`` so every exit path — clean return, uncaught exception,
+    ``sys.exit`` — tears the group down.
     """
     env_procs = os.environ.get("JAX_NUM_PROCESSES")
     if num_processes is None and env_procs is not None:
         num_processes = int(env_procs)
-    if num_processes is not None and num_processes > 1:
-        plats = str(jax.config.jax_platforms
-                    or os.environ.get("JAX_PLATFORMS", ""))
-        if "cpu" in plats:
-            # CPU cross-process collectives need an explicit backend;
-            # gloo ships with jaxlib (the reference's gloo-on-CPU-ranks
-            # mode, modal_utils.py / SURVEY.md §7.1).
-            jax.config.update("jax_cpu_collectives_implementation",
-                              "gloo")
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+    if num_processes is None or num_processes <= 1:
+        return
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("DTS_BRINGUP_TIMEOUT") or 120.0)
+    plats = str(jax.config.jax_platforms
+                or os.environ.get("JAX_PLATFORMS", ""))
+    if "cpu" in plats:
+        # CPU cross-process collectives need an explicit backend;
+        # gloo ships with jaxlib (the reference's gloo-on-CPU-ranks
+        # mode, modal_utils.py / SURVEY.md §7.1).
+        jax.config.update("jax_cpu_collectives_implementation",
+                          "gloo")
+    if process_id is not None and process_id != 0 and coordinator_address:
+        # jaxlib's coordination client converts a RegisterTask deadline
+        # into a process-terminating FATAL abort — it never raises into
+        # Python.  An unreachable coordinator must therefore be caught
+        # BEFORE initialize, with a bounded TCP preflight; once the
+        # coordinator accepts, initialize proceeds normally.
+        host, _, port = coordinator_address.rpartition(":")
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                socket.create_connection(
+                    (host or "127.0.0.1", int(port)),
+                    timeout=min(1.0, timeout_s)).close()
+                break
+            except OSError as e:
+                if time.monotonic() >= deadline:
+                    raise BringupTimeout(
+                        coordinator_address, num_processes, process_id,
+                        timeout_s, cause=f"{type(e).__name__}: {e}") from e
+                time.sleep(0.2)
+    attempts, max_attempts = 0, 3
+    while True:
+        attempts += 1
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                initialization_timeout=max(1, int(timeout_s)),
+            )
+            break
+        except Exception as e:  # noqa: BLE001 - classified + re-raised
+            msg = str(e)
+            if ("EADDRINUSE" in msg or "address already in use" in
+                    msg.lower()) and attempts < max_attempts:
+                # coordinator port lingering in TIME_WAIT from the
+                # previous group on the same address — transient
+                print(f"[mesh] coordinator port busy "
+                      f"({coordinator_address}), retry "
+                      f"{attempts}/{max_attempts - 1}")
+                time.sleep(0.5 * attempts)
+                continue
+            if ("DEADLINE_EXCEEDED" in msg or "timed out" in msg.lower()
+                    or "timeout" in msg.lower()):
+                raise BringupTimeout(coordinator_address, num_processes,
+                                     process_id, timeout_s,
+                                     cause=msg.splitlines()[0]) from e
+            raise
+    atexit.register(shutdown_distributed)
+
+
+def shutdown_distributed() -> None:
+    """Idempotent ``jax.distributed.shutdown`` — the teardown half of
+    :func:`setup_distributed`, safe to call from a ``finally`` on any
+    exit path (and registered via ``atexit`` so interpreter exit covers
+    the paths no ``finally`` reaches).  A failed shutdown is reported,
+    not raised: teardown must never mask the error that caused it."""
+    global _DTS_INITIALIZED
+    client = getattr(jax.distributed, "global_state", None)
+    if client is None or getattr(client, "client", None) is None:
+        _DTS_INITIALIZED = False
+        return
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:  # noqa: BLE001 - teardown must not mask errors
+        print(f"[mesh] WARNING: jax.distributed.shutdown failed: "
+              f"{type(e).__name__}: {e}")
+    _DTS_INITIALIZED = False
+
+
+def bringup_barrier(timeout_s: float = 120.0) -> None:
+    """Cross-process bring-up barrier: one tiny psum over EVERY device,
+    run under the elastic :class:`~..resilience.elastic.Watchdog` so a
+    peer that wedges after connecting surfaces as the same
+    ``StepTimeoutError`` the step-level watchdog raises — one timeout
+    machinery for bring-up and steady state.  Verifies the sum, so a
+    short-changed mesh (a peer initialized with fewer devices than the
+    group believes) is caught here, not ten minutes into training."""
+    from ..resilience.elastic import Watchdog
+
+    def _sync() -> float:
+        devs = np.asarray(jax.devices())
+        mesh = Mesh(devs, ("all",))
+        ones = host_to_global(np.ones((devs.size,), np.float32),
+                              mesh, PartitionSpec("all"))
+        total = jax.jit(lambda x: x.sum(),
+                        out_shardings=NamedSharding(mesh, PartitionSpec())
+                        )(ones)
+        return local_scalar(total)
+
+    wd = Watchdog(timeout_s=timeout_s)
+    total = wd.block(_sync, step=-1)
+    ndev = len(jax.devices())
+    if int(total) != ndev:
+        raise RuntimeError(
+            f"bring-up barrier mismatch: psum saw {int(total)} devices, "
+            f"backend reports {ndev} — mesh does not span the group")
 
 
 def make_mesh(
@@ -197,6 +337,47 @@ def host_to_global(arr, mesh: Mesh, spec: PartitionSpec) -> jax.Array:
         return jax.device_put(arr, sharding)
     return jax.make_array_from_callback(arr.shape, sharding,
                                         lambda idx: arr[idx])
+
+
+def process_local_put(arr, mesh: Mesh, spec: PartitionSpec) -> jax.Array:
+    """Stage a host-identical batch as one GLOBAL array by handing JAX
+    only this process's slice — ``jax.make_array_from_process_local_data``,
+    the data path the torchrun contract implies: each worker materializes
+    its own shard, never the full global batch on-device.
+
+    Single-process (or a spec fully addressable from here) degrades to
+    plain ``device_put``.  When this process's shards are not one
+    contiguous block of the global array (e.g. a strided device order),
+    falls back to :func:`host_to_global`'s per-shard callback, which
+    handles any layout.
+    """
+    arr = np.asarray(arr)
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1 or sharding.is_fully_addressable:
+        return jax.device_put(arr, sharding)
+    idx_map = sharding.addressable_devices_indices_map(arr.shape)
+    # bounding box of the local shards, per dimension
+    lo = [d for d in arr.shape]
+    hi = [0] * arr.ndim
+    for idx in idx_map.values():
+        for d, sl in enumerate(idx):
+            start = 0 if sl.start is None else sl.start
+            stop = arr.shape[d] if sl.stop is None else sl.stop
+            lo[d] = min(lo[d], start)
+            hi[d] = max(hi[d], stop)
+    box = tuple(slice(a, b) for a, b in zip(lo, hi))
+    uniq_bounds = {
+        tuple(((0 if sl.start is None else sl.start),
+               (arr.shape[d] if sl.stop is None else sl.stop))
+              for d, sl in enumerate(idx))
+        for idx in idx_map.values()}
+    covered = sum(math.prod(b - a for a, b in bounds)
+                  for bounds in uniq_bounds)
+    if covered != math.prod(b - a for a, b in zip(lo, hi)):
+        # local shards don't tile the box — non-contiguous layout
+        return host_to_global(arr, mesh, spec)
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(arr[box]), arr.shape)
 
 
 def local_scalar(x) -> float:

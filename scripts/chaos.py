@@ -1,0 +1,732 @@
+"""Chaos campaign harness: sweep the (fault kind x strategy) matrix
+and assert every cell's invariants.
+
+Each registered fault kind in ``resilience.faults.FAULT_REGISTRY`` must
+have at least one campaign cell (the sweep refuses to run otherwise, so
+a new fault kind cannot ship without chaos coverage), plus the
+corrupt-checkpoint cells built on ``faults.corrupt_checkpoint``.  The
+matrix crosses the training faults (crash / preempt / kill_worker /
+hang / slow / corrupt-ckpt) with ddp, zero3 and fsdp, and the serving
+faults (kill_replica / hang_decode / slow_replica / corrupt_swap) with
+the replica fleet.  Per-cell invariants:
+
+  * bitwise resume   — the stitched loss sequence (or replayed token
+    streams) is bitwise-identical to an undisturbed reference
+  * zero drops       — every admitted serving request completes
+  * bounded detection — hangs become StepTimeoutError inside the
+    watchdog budget, never silent wedges
+  * clean reaping / no orphans — real spawned cells leave no zombie
+    and no orphaned worker process behind
+
+Cells tagged ``real`` spawn actual OS worker processes through
+``dts-launch`` (the 2-process gloo mesh) and are skipped by default;
+``--real`` turns them on.  Results land in ``chaos_report.json``
+(schema below), indexed by ``scripts/runs.py index`` and rendered by
+``scripts/report.py``.  Any red cell exits nonzero.
+
+  python scripts/chaos.py                      # sim matrix (>= 12 cells)
+  python scripts/chaos.py --real               # + spawned 2-process cells
+  python scripts/chaos.py --cells 'fleet-*'    # one strategy's row
+  python scripts/chaos.py --list               # show the matrix, don't run
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import traceback
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# the same 8-simulated-CPU-device substrate as tests/conftest.py — must
+# run before the JAX backend initializes (no-op when it already did)
+from distributed_training_sandbox_tpu.utils import use_cpu_devices  # noqa: E402
+
+use_cpu_devices(8)
+
+from distributed_training_sandbox_tpu.resilience.faults import (  # noqa: E402
+    FAULT_REGISTRY,
+    SERVING_FAULT_KINDS,
+    corrupt_checkpoint,
+)
+
+REPORT_SCHEMA = 1
+
+
+# --------------------------------------------------------------- registry
+
+@dataclass
+class Cell:
+    name: str
+    fault: str
+    strategy: str
+    fn: object
+    tags: tuple = ()
+    doc: str = ""
+
+
+CELLS: dict[str, Cell] = {}
+
+
+def cell(fault: str, strategy: str, tags: tuple = ()):
+    def deco(fn):
+        name = f"{strategy}-{fault}"
+        if name in CELLS:
+            raise SystemExit(f"[chaos] duplicate cell {name}")
+        CELLS[name] = Cell(name, fault, strategy, fn, tuple(tags),
+                           (fn.__doc__ or "").strip().splitlines()[0]
+                           if fn.__doc__ else "")
+        return fn
+    return deco
+
+
+@dataclass
+class Campaign:
+    """Shared state across cells: the workdir and a cache of clean
+    reference runs (several cells compare against the same undisturbed
+    trajectory — computing it once keeps the sweep honest AND fast)."""
+    work: Path
+    _refs: dict = field(default_factory=dict)
+
+    def dir(self, name: str) -> Path:
+        d = self.work / name
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def ref(self, key: str, fn):
+        if key not in self._refs:
+            self._refs[key] = fn()
+        return self._refs[key]
+
+
+# ------------------------------------------------------- training: ddp
+
+DDP8 = ["--scale", "200", "--num-steps", "8", "--no-profile",
+        "--batch-size", "16", "--sync-every", "2"]
+EDDP = ["--scale", "100", "--no-profile", "--batch-size", "16",
+        "--sync-every", "2", "--checkpoint-every", "2"]
+
+
+def _ddp_ref8(c: Campaign):
+    import scripts.ddp as ddp
+    return c.ref("ddp8", lambda: ddp.main(
+        DDP8 + ["--results-dir", str(c.dir("ref-ddp8"))])["losses"])
+
+
+@cell("crash", "ddp")
+def ddp_crash(c: Campaign):
+    """crash@5 under --max-restarts: in-process restart resumes from
+    the step-3 checkpoint and the stitched run is bitwise-clean."""
+    import scripts.ddp as ddp
+    w = c.dir("ddp-crash")
+    out = ddp.main(DDP8 + [
+        "--results-dir", str(w / "runs"),
+        "--checkpoint-dir", str(w / "ck"), "--checkpoint-every", "2",
+        "--inject-fault", "crash@5", "--max-restarts", "1"])
+    ref = _ddp_ref8(c)
+    return {"completed": len(out["losses"]) == 8,
+            "bitwise_resume": out["losses"] == ref}
+
+
+@cell("preempt", "ddp")
+def ddp_preempt(c: Campaign):
+    """preempt@5 (real SIGTERM): drain, final checkpoint, resume —
+    bitwise-stitched, with the preempted segment in the lineage."""
+    import scripts.ddp as ddp
+    w = c.dir("ddp-preempt")
+    out = ddp.main(DDP8 + [
+        "--results-dir", str(w / "runs"),
+        "--checkpoint-dir", str(w / "ck"), "--checkpoint-every", "2",
+        "--inject-fault", "preempt@5", "--max-restarts", "2"])
+    ref = _ddp_ref8(c)
+    lineages = []
+    for d in sorted((w / "runs").iterdir()):
+        man = json.loads((d / "manifest.json").read_text())
+        if man.get("lineage"):
+            lineages.append(man["lineage"])
+    segs = [s for lin in lineages for s in lin.get("segments", [])]
+    return {"completed": len(out["losses"]) == 8,
+            "bitwise_resume": out["losses"] == ref,
+            "lineage_has_preempted_segment":
+                any(s.get("status") == "preempted" for s in segs)}
+
+
+@cell("kill_worker", "ddp")
+def ddp_kill_worker(c: Campaign):
+    """kill_worker@5:6 + --elastic (sim): shrink 8 -> 4 survivors,
+    reshard-restore, stitched losses bitwise vs the clean-small twin,
+    mesh transition recorded in the checkpoint lineage."""
+    import scripts.ddp as ddp
+    w = c.dir("ddp-kill")
+    out = ddp.main(EDDP + [
+        "--num-steps", "10", "--results-dir", str(w / "runs"),
+        "--checkpoint-dir", str(w / "ckA"),
+        "--elastic", "--inject-fault", "kill_worker@5:6",
+        "--max-restarts", "1"])
+
+    def clean_small():
+        ddp.main(EDDP + ["--num-steps", "4",
+                         "--results-dir", str(c.dir("ref-kill") / "r1"),
+                         "--checkpoint-dir",
+                         str(c.dir("ref-kill") / "ck")])
+        return ddp.main(EDDP + [
+            "--num-steps", "10",
+            "--results-dir", str(c.dir("ref-kill") / "r2"),
+            "--checkpoint-dir", str(c.dir("ref-kill") / "ck"),
+            "--resume", "--world-size", "4"])["losses"]
+    ref = c.ref("ddp-kill-small", clean_small)
+    sidecars = sorted((p for p in (w / "ckA").iterdir()
+                       if p.name.startswith("runstate-")),
+                      key=lambda p: int(p.stem.split("-")[1]))
+    side = json.loads(sidecars[-1].read_text()) if sidecars else {}
+    trans = side.get("lineage", {}).get("mesh_transitions") or []
+    return {"completed": len(out["losses"]) == 10,
+            "bitwise_resume": out["losses"] == ref,
+            "mesh_transition_recorded":
+                bool(trans) and trans[0].get("new_world") == 4,
+            "lost_rank_attributed":
+                bool(trans) and trans[0].get("lost_ranks") == [6]}
+
+
+@cell("hang", "ddp")
+def ddp_hang(c: Campaign):
+    """hang@4 + watchdog + --elastic: the wedge becomes
+    StepTimeoutError inside the 2 s watchdog budget, feeds the shrink
+    path, and the stitched run is bitwise-clean."""
+    import scripts.ddp as ddp
+    w = c.dir("ddp-hang")
+    t0 = time.monotonic()
+    out = ddp.main(EDDP + [
+        "--num-steps", "8", "--results-dir", str(w / "runs"),
+        "--checkpoint-dir", str(w / "ck"),
+        "--elastic", "--inject-fault", "hang@4",
+        "--watchdog-timeout", "2", "--max-restarts", "1"])
+    wall = time.monotonic() - t0
+
+    def clean_small():
+        ddp.main(EDDP + ["--num-steps", "4",
+                         "--results-dir", str(c.dir("ref-hang") / "r1"),
+                         "--checkpoint-dir",
+                         str(c.dir("ref-hang") / "ck")])
+        return ddp.main(EDDP + [
+            "--num-steps", "8",
+            "--results-dir", str(c.dir("ref-hang") / "r2"),
+            "--checkpoint-dir", str(c.dir("ref-hang") / "ck"),
+            "--resume", "--world-size", "4"])["losses"]
+    ref = c.ref("ddp-hang-small", clean_small)
+    return {"completed": len(out["losses"]) == 8,
+            "bitwise_resume": out["losses"] == ref,
+            "bounded_detection": wall < 120.0}
+
+
+@cell("slow", "ddp")
+def ddp_slow(c: Campaign):
+    """slow@3:50 (straggler sleep): numerically inert — the run
+    completes with losses bitwise-equal to the undisturbed one."""
+    import scripts.ddp as ddp
+    w = c.dir("ddp-slow")
+    out = ddp.main(DDP8 + [
+        "--results-dir", str(w / "runs"),
+        "--inject-fault", "slow@3:50"])
+    ref = _ddp_ref8(c)
+    return {"completed": len(out["losses"]) == 8,
+            "bitwise_unchanged": out["losses"] == ref}
+
+
+@cell("corrupt_ckpt", "ddp")
+def ddp_corrupt_ckpt(c: Campaign):
+    """Corrupt the newest checkpoint step: resume SKIPS the torn step
+    with a readable warning (never a raw tensorstore traceback), falls
+    back to the previous intact one, and the re-run stitches
+    bitwise-clean."""
+    import contextlib
+    import io
+    import scripts.ddp as ddp
+    w = c.dir("ddp-corrupt")
+    ck = w / "ck"
+    ddp.main(DDP8 + ["--results-dir", str(w / "r1"),
+                     "--checkpoint-dir", str(ck),
+                     "--checkpoint-every", "2"])
+    corrupt_checkpoint(ck)          # tears the newest step (7)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        out = ddp.main(DDP8 + ["--results-dir", str(w / "r2"),
+                               "--checkpoint-dir", str(ck),
+                               "--resume"])
+    ref = _ddp_ref8(c)
+    return {"readable_torn_step_warning":
+                "torn or corrupt" in buf.getvalue(),
+            "fallback_resume_bitwise": out["losses"] == ref}
+
+
+# ----------------------------------------------------- training: zero3
+
+Z3 = ["--scale", "200", "--num-steps", "6", "--no-profile",
+      "--sync-every", "2"]
+
+
+def _z3_ref(c: Campaign):
+    from scripts._zero_driver import run_zero_ab
+    return c.ref("z3", lambda: run_zero_ab(3, Z3 + [
+        "--results-dir", str(c.dir("ref-z3"))]))
+
+
+def _z3_bitwise(out, ref):
+    return {"base_bitwise": out["base_losses"] == ref["base_losses"],
+            "shard_bitwise": out["shard_losses"] == ref["shard_losses"]}
+
+
+@cell("preempt", "zero3")
+def zero3_preempt(c: Campaign):
+    """preempt@3:sharded: zero3's dp-sharded params AND opt state
+    survive preemption mid-leg; both legs stitch bitwise."""
+    from scripts._zero_driver import run_zero_ab
+    w = c.dir("z3-preempt")
+    out = run_zero_ab(3, Z3 + [
+        "--results-dir", str(w / "runs"),
+        "--checkpoint-dir", str(w / "ck"), "--checkpoint-every", "2",
+        "--inject-fault", "preempt@3:sharded", "--max-restarts", "1"])
+    return _z3_bitwise(out, _z3_ref(c))
+
+
+@cell("crash", "zero3")
+def zero3_crash(c: Campaign):
+    """crash@3:sharded: the in-process restart reshard-restores the
+    sharded leg's checkpoint; both legs stitch bitwise."""
+    from scripts._zero_driver import run_zero_ab
+    w = c.dir("z3-crash")
+    out = run_zero_ab(3, Z3 + [
+        "--results-dir", str(w / "runs"),
+        "--checkpoint-dir", str(w / "ck"), "--checkpoint-every", "2",
+        "--inject-fault", "crash@3:sharded", "--max-restarts", "1"])
+    return _z3_bitwise(out, _z3_ref(c))
+
+
+@cell("slow", "zero3")
+def zero3_slow(c: Campaign):
+    """slow@2:60 straggler on zero3: numerically inert."""
+    from scripts._zero_driver import run_zero_ab
+    w = c.dir("z3-slow")
+    out = run_zero_ab(3, Z3 + ["--results-dir", str(w / "runs"),
+                               "--inject-fault", "slow@2:60"])
+    return _z3_bitwise(out, _z3_ref(c))
+
+
+# ------------------------------------------------------ training: fsdp
+
+FS = ["--num-steps", "6", "--no-profile", "--batch-size", "8",
+      "--sync-every", "2"]
+
+
+def _fsdp_ref(c: Campaign):
+    import scripts.train_fsdp as fsdp
+    return c.ref("fsdp", lambda: fsdp.main(FS + [
+        "--results-dir", str(c.dir("ref-fsdp"))])["losses"])
+
+
+@cell("crash", "fsdp")
+def fsdp_crash(c: Campaign):
+    """crash@3 on the fsdp driver: restart resumes the sharded params +
+    opt state from the step-1 checkpoint; stitched bitwise."""
+    import scripts.train_fsdp as fsdp
+    w = c.dir("fsdp-crash")
+    out = fsdp.main(FS + [
+        "--results-dir", str(w / "runs"),
+        "--checkpoint-dir", str(w / "ck"), "--checkpoint-every", "2",
+        "--inject-fault", "crash@3", "--max-restarts", "1"])
+    ref = _fsdp_ref(c)
+    return {"completed": len(out["losses"]) == 6,
+            "bitwise_resume": out["losses"] == ref}
+
+
+@cell("preempt", "fsdp")
+def fsdp_preempt(c: Campaign):
+    """preempt@3 (SIGTERM) on the fsdp driver: drain + final
+    checkpoint + resume, stitched bitwise."""
+    import scripts.train_fsdp as fsdp
+    w = c.dir("fsdp-preempt")
+    out = fsdp.main(FS + [
+        "--results-dir", str(w / "runs"),
+        "--checkpoint-dir", str(w / "ck"), "--checkpoint-every", "2",
+        "--inject-fault", "preempt@3", "--max-restarts", "1"])
+    ref = _fsdp_ref(c)
+    return {"completed": len(out["losses"]) == 6,
+            "bitwise_resume": out["losses"] == ref}
+
+
+# ------------------------------------------------------- serving fleet
+
+def _fleet_bits():
+    import numpy as np
+    import jax
+    from distributed_training_sandbox_tpu.models import transformer as T
+
+    cfg = T.TINY_LM
+
+    def chaotic_params(seed=0, scale=3.0):
+        params = T.init_params(jax.random.PRNGKey(seed), cfg)
+        return jax.tree.map(lambda x: (x * scale).astype(x.dtype),
+                            params)
+
+    def trace(n, seed=0, plen=5, span_s=0.3):
+        rng = np.random.default_rng(seed)
+        prompts = [rng.integers(1, cfg.vocab_size, size=plen)
+                   .astype(np.int32) for _ in range(n)]
+        arrivals = np.sort(rng.uniform(0.0, span_s, size=n))
+        arrivals[0] = 0.0
+        return list(zip(prompts, arrivals))
+
+    def bitwise(fleet, params, reqs, max_new=5):
+        from distributed_training_sandbox_tpu.models.generate import (
+            generate)
+        for r in reqs:
+            ref = np.asarray(generate(
+                params, r.prompt[None], cfg, max_new_tokens=max_new,
+                cache_capacity=fleet.view_capacity))[0]
+            got = np.asarray(r.tokens, np.int32)
+            if got.shape != ref.shape or not (got == ref).all():
+                return False
+        return True
+
+    eng = dict(max_batch=2, page_size=8, max_seq_len=32,
+               prefill_chunk=8, sync_every=2)
+    return cfg, chaotic_params, trace, bitwise, eng
+
+
+@cell("kill_replica", "fleet")
+def fleet_kill_replica(c: Campaign):
+    """kill_replica@1:1 mid-trace: failover replays the dead replica's
+    in-flight requests on the survivor — zero drops, bitwise token
+    streams, page pool back to zero."""
+    from distributed_training_sandbox_tpu.serving import Fleet
+    cfg, mk, trace, bitwise, eng = _fleet_bits()
+    params = mk()
+    fleet = Fleet(params, cfg, replicas=2, watchdog_timeout_s=0.0,
+                  fault="kill_replica@1:1", max_queue=16, **eng)
+    reqs = [fleet.submit(p, max_new_tokens=5, arrival_s=t)
+            for p, t in trace(10, seed=3)]
+    done = fleet.run()
+    ev = [e for e in fleet.events if e["event"] == "replica_dead"]
+    return {"zero_drops": len(done) == 10 and fleet.dropped() == [],
+            "death_detected":
+                len(ev) == 1 and ev[0]["trigger"] == "WorkerLost",
+            "bitwise_replay": bitwise(fleet, params, reqs),
+            "pool_clean":
+                fleet.replicas[0].engine.pool.allocator.pages_in_use
+                == 0}
+
+
+@cell("hang_decode", "fleet")
+def fleet_hang_decode(c: Campaign):
+    """hang_decode@1:0: the watchdog converts the wedged burst into
+    StepTimeoutError in bounded time; failover completes everything."""
+    from distributed_training_sandbox_tpu.serving import Fleet
+    cfg, mk, trace, bitwise, eng = _fleet_bits()
+    params = mk(seed=1)
+    fleet = Fleet(params, cfg, replicas=2, watchdog_timeout_s=0.5,
+                  fault="hang_decode@1:0", max_queue=16, **eng)
+    reqs = [fleet.submit(p, max_new_tokens=5, arrival_s=t)
+            for p, t in trace(8, seed=5)]
+    t0 = time.monotonic()
+    done = fleet.run()
+    wall = time.monotonic() - t0
+    return {"zero_drops": len(done) == 8 and fleet.dropped() == [],
+            "bounded_detection":
+                wall < 120.0
+                and fleet.replicas[0].death == "StepTimeoutError",
+            "bitwise_replay": bitwise(fleet, params, reqs)}
+
+
+@cell("slow_replica", "fleet")
+def fleet_slow_replica(c: Campaign):
+    """slow_replica@1:80: a lagging replica is latency, not
+    corruption — zero drops, bitwise streams."""
+    from distributed_training_sandbox_tpu.serving import Fleet
+    cfg, mk, trace, bitwise, eng = _fleet_bits()
+    params = mk(seed=2)
+    fleet = Fleet(params, cfg, replicas=2, watchdog_timeout_s=0.0,
+                  fault="slow_replica@1:80", max_queue=16, **eng)
+    reqs = [fleet.submit(p, max_new_tokens=5, arrival_s=t)
+            for p, t in trace(8, seed=7)]
+    done = fleet.run()
+    return {"zero_drops": len(done) == 8 and fleet.dropped() == [],
+            "bitwise_replay": bitwise(fleet, params, reqs)}
+
+
+@cell("corrupt_swap", "fleet")
+def fleet_corrupt_swap(c: Campaign):
+    """corrupt_swap: a torn swap checkpoint aborts the hot-swap and the
+    fleet keeps serving the OLD weights — zero drops, bitwise on the
+    old params, no replica ever swapped."""
+    from distributed_training_sandbox_tpu.serving import Fleet
+    from distributed_training_sandbox_tpu.resilience.state import (
+        Checkpointer, RunState)
+    cfg, mk, trace, bitwise, eng = _fleet_bits()
+    old, new = mk(seed=0), mk(seed=9)
+    w = c.dir("fleet-corrupt-swap")
+    ck = Checkpointer(w / "swap")
+    ck.save(RunState(params=new, step=0), wait=True)
+    ck.close()
+    fleet = Fleet(old, cfg, replicas=2, watchdog_timeout_s=0.0,
+                  fault="corrupt_swap", max_queue=32, **eng)
+    reqs = [fleet.submit(p, max_new_tokens=5, arrival_s=t)
+            for p, t in trace(8, seed=17)]
+    fleet.schedule_swap(w / "swap", after_completed=3)
+    done = fleet.run()
+    names = [e["event"] for e in fleet.events]
+    return {"zero_drops": len(done) == 8 and fleet.dropped() == [],
+            "swap_aborted_readably":
+                "swap_fault_injected" in names
+                and "swap_failed" in names
+                and "swap_replica" not in names,
+            "old_weights_bitwise": bitwise(fleet, old, reqs)}
+
+
+# ------------------------------------------- real spawned worker cells
+
+def _launch(args, workdir: Path, extra_env=None, timeout=420):
+    """Run dts-launch in a subprocess with a hermetic env (the chaos
+    process's 8-device XLA_FLAGS must not leak into the workers — the
+    launcher sets each worker's device count itself)."""
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": str(REPO),
+                "RESULTS_DIR": str(workdir / "runs")})
+    env.update(extra_env or {})
+    cmd = [sys.executable, "-m",
+           "distributed_training_sandbox_tpu.launch.cli", "run"] + args
+    return subprocess.run(cmd, env=env, cwd=str(REPO), timeout=timeout,
+                          capture_output=True, text=True)
+
+
+def _orphans(pattern: str) -> list[str]:
+    """Worker processes still alive after the launcher exited."""
+    out = subprocess.run(["pgrep", "-af", pattern],
+                         capture_output=True, text=True).stdout
+    return [ln for ln in out.splitlines()
+            if ln.strip() and str(os.getpid()) != ln.split()[0]]
+
+
+@cell("bringup", "real", tags=("real", "smoke"))
+def real_bringup(c: Campaign):
+    """The 2-process smoke cell: distributed bring-up through the
+    drivers, one global mesh over both workers, coordinator stamped in
+    the manifest, clean teardown with every worker reaped."""
+    w = c.dir("real-bringup")
+    r = _launch(["--script", "ddp", "--num-steps", "2",
+                 "--devices", "cpu:2", "--nprocs", "2", "--distributed",
+                 "--trace-root", str(w / "trace"),
+                 "--", "--scale", "100", "--batch-size", "16",
+                 "--no-profile"], w)
+    manifests = list((w / "runs").glob("*/manifest.json"))
+    coord = any("coordinator" in (json.loads(m.read_text())
+                                  .get("extra") or {})
+                for m in manifests)
+    mesh4 = "devices=4" in r.stdout
+    return {"clean_exit": r.returncode == 0,
+            "global_mesh_spans_processes": mesh4,
+            "coordinator_in_manifest": coord,
+            "no_orphans": _orphans("scripts/ddp.py") == [],
+            "detail": "" if r.returncode == 0 else r.stdout[-2000:]}
+
+
+@cell("kill_worker", "real", tags=("real",))
+def real_kill_worker(c: Campaign):
+    """The real thing: kill_worker@4:1 SIGKILLs worker 1's OS process;
+    the coordinator detects via the heartbeat breadcrumb, tears down,
+    re-initializes at the survivor count, and the resumed trajectory is
+    bitwise-identical to a clean small-world run.  No zombie, no
+    orphan."""
+    w = c.dir("real-kill")
+    t0 = time.monotonic()
+    ra = _launch(["--script", "ddp", "--num-steps", "8",
+                  "--devices", "cpu:2", "--nprocs", "2",
+                  "--distributed", "--elastic",
+                  "--heartbeat-timeout", "5",
+                  "--trace-root", str(w / "traceA"),
+                  "--", "--scale", "100", "--batch-size", "32",
+                  "--no-profile", "--sync-every", "2",
+                  "--checkpoint-every", "2",
+                  "--checkpoint-dir", str(w / "ckA"),
+                  "--inject-fault", "kill_worker@4:1"], w)
+    wall = time.monotonic() - t0
+    # which step did the survivors actually resume from?  The async
+    # save racing the SIGKILL decides whether the newest checkpoint was
+    # intact — both outcomes are correct elastic behavior; the
+    # clean-small twin must just resume from the SAME step.
+    resumed = -1
+    for log in (w / "traceA").glob("*/worker_0.log"):
+        for ln in log.read_text().splitlines():
+            if "resumed from step " in ln:
+                resumed = int(ln.split("resumed from step ")[1]
+                              .split()[0])
+    # clean-small twin: 4-device run whose newest checkpoint is that
+    # step, then a 2-device resume to step 8
+    rb1 = _launch(["--script", "ddp", "--num-steps", str(resumed + 1),
+                   "--devices", "cpu:4",
+                   "--trace-root", str(w / "traceB1"),
+                   "--", "--scale", "100", "--batch-size", "32",
+                   "--no-profile", "--sync-every", "2",
+                   "--checkpoint-every", "2",
+                   "--checkpoint-dir", str(w / "ckB")], w)
+    rb2 = _launch(["--script", "ddp", "--num-steps", "8",
+                   "--devices", "cpu:2",
+                   "--trace-root", str(w / "traceB2"),
+                   "--", "--scale", "100", "--batch-size", "32",
+                   "--no-profile", "--sync-every", "2",
+                   "--checkpoint-every", "2",
+                   "--checkpoint-dir", str(w / "ckB"), "--resume"], w)
+
+    def losses(ck):
+        side = sorted(ck.glob("runstate-*.json"),
+                      key=lambda p: int(p.stem.split("-")[1]))
+        return [repr(v) for v in
+                json.loads(side[-1].read_text())["loss_log"]] \
+            if side else []
+    la, lb = losses(w / "ckA"), losses(w / "ckB")
+    breadcrumb = list((w / "traceA").glob("*/heartbeats-0/*.dead"))
+    side = sorted((w / "ckA").glob("runstate-*.json"),
+                  key=lambda p: int(p.stem.split("-")[1]))
+    trans = (json.loads(side[-1].read_text())["lineage"]
+             .get("mesh_transitions") or []) if side else []
+    return {"clean_exit": ra.returncode == 0 and rb1.returncode == 0
+                          and rb2.returncode == 0,
+            "resumed_from_checkpoint": resumed >= 1,
+            "breadcrumb_written": bool(breadcrumb),
+            "shrink_relaunched": "relaunching 2 -> 1" in ra.stdout,
+            "mesh_transition_in_lineage":
+                bool(trans) and trans[0].get("new_world") == 1,
+            "bitwise_resume": bool(la) and la == lb and len(la) == 8,
+            "bounded_detection": wall < 300.0,
+            "no_orphans": _orphans("scripts/ddp.py") == [],
+            "detail": "" if ra.returncode == 0 else ra.stdout[-2000:]}
+
+
+# --------------------------------------------------------------- runner
+
+def _coverage_check() -> None:
+    covered = {c.fault for c in CELLS.values()}
+    missing = [k for k in FAULT_REGISTRY if k not in covered
+               and k not in SERVING_FAULT_KINDS]
+    missing += [k for k in SERVING_FAULT_KINDS if k not in covered]
+    if missing:
+        raise SystemExit(
+            f"[chaos] FAULT_REGISTRY kind(s) without a campaign cell: "
+            f"{sorted(set(missing))} — every registered fault needs "
+            f"chaos coverage")
+
+
+def select_cells(patterns: list[str] | None,
+                 real: bool) -> list[Cell]:
+    cells = list(CELLS.values())
+    if patterns:
+        cells = [c for c in cells
+                 if any(fnmatch.fnmatch(c.name, p) for p in patterns)]
+    elif not real:
+        cells = [c for c in cells if "real" not in c.tags]
+    return cells
+
+
+def run_campaign(cells: list[Cell], work: Path) -> dict:
+    camp = Campaign(work=work)
+    rows = []
+    for cl in cells:
+        print(f"[chaos] cell {cl.name} ({cl.fault} x {cl.strategy}) "
+              f"...", flush=True)
+        t0 = time.monotonic()
+        try:
+            inv = cl.fn(camp)
+            detail = inv.pop("detail", "") if isinstance(inv, dict) \
+                else ""
+            ok = bool(inv) and all(bool(v) for v in inv.values())
+            status = "green" if ok else "red"
+        except Exception:
+            inv, detail, status = {}, traceback.format_exc(), "red"
+        dt = round(time.monotonic() - t0, 2)
+        rows.append({"cell": cl.name, "fault": cl.fault,
+                     "strategy": cl.strategy, "status": status,
+                     "invariants": inv, "duration_s": dt,
+                     "detail": detail})
+        bad = [k for k, v in inv.items() if not v]
+        print(f"[chaos]   {status.upper()} in {dt:.1f}s"
+              + (f" — failed: {bad}" if status == "red" and bad else "")
+              + (f"\n{detail}" if status == "red" and detail else ""),
+              flush=True)
+    green = sum(r["status"] == "green" for r in rows)
+    return {"schema": REPORT_SCHEMA,
+            "started_utc": datetime.now(timezone.utc).isoformat(),
+            "cells": rows,
+            "summary": {"total": len(rows), "green": green,
+                        "red": len(rows) - green}}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="chaos campaign: (fault x strategy) matrix with "
+                    "per-cell invariants")
+    p.add_argument("--cells", action="append", default=None,
+                   metavar="GLOB",
+                   help="run only cells matching GLOB (repeatable); "
+                        "overrides the default real-cell exclusion")
+    p.add_argument("--real", action="store_true",
+                   help="include cells that spawn real OS worker "
+                        "processes (2-process gloo mesh; slower)")
+    p.add_argument("--report", default="chaos_report.json",
+                   help="where to write the campaign report "
+                        "(default ./chaos_report.json)")
+    p.add_argument("--workdir", default=None,
+                   help="campaign scratch dir (default: a temp dir, "
+                        "removed on success)")
+    p.add_argument("--list", action="store_true",
+                   help="print the matrix and exit")
+    args = p.parse_args(argv)
+
+    _coverage_check()
+    cells = select_cells(args.cells, args.real)
+    if args.list:
+        for cl in CELLS.values():
+            sel = "x" if cl in cells else " "
+            tags = f" [{','.join(cl.tags)}]" if cl.tags else ""
+            print(f" [{sel}] {cl.name:22} {cl.fault:13} "
+                  f"{cl.strategy:6}{tags}  {cl.doc}")
+        print(f"[chaos] {len(cells)}/{len(CELLS)} cell(s) selected")
+        return 0
+    if not cells:
+        print(f"[chaos] no cells match {args.cells}", file=sys.stderr)
+        return 2
+
+    keep = args.workdir is not None
+    work = Path(args.workdir) if args.workdir else \
+        Path(tempfile.mkdtemp(prefix="dts-chaos-"))
+    work.mkdir(parents=True, exist_ok=True)
+    os.environ.setdefault("RESULTS_DIR", str(work / "runs"))
+    report = run_campaign(cells, work)
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    s = report["summary"]
+    print(f"[chaos] {s['green']}/{s['total']} cell(s) green -> "
+          f"{args.report}")
+    if s["red"]:
+        red = [r["cell"] for r in report["cells"]
+               if r["status"] == "red"]
+        print(f"[chaos] RED cells: {red}", file=sys.stderr)
+        return 1
+    if not keep:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -92,6 +92,17 @@ def main(argv=None) -> int:
     recs = R.discover_runs(args.paths)
     rows = [R.run_row(rec) for rec in recs]
 
+    # a chaos campaign report sitting in a results root rides along
+    chaos_docs = []
+    for root in args.paths:
+        cp = Path(root) / "chaos_report.json"
+        if cp.is_file():
+            try:
+                with open(cp) as f:
+                    chaos_docs.append((json.load(f), str(cp)))
+            except (OSError, json.JSONDecodeError):
+                pass
+
     schema_problems = []
     if args.strict:
         for rec in recs:
@@ -144,6 +155,7 @@ def main(argv=None) -> int:
         print(json.dumps({"runs": rows, "comparisons": comparisons,
                           "overlap_comparisons": overlap_cmp,
                           "bandwidth_comparisons": bw_cmp,
+                          "chaos": [doc for doc, _ in chaos_docs],
                           "schema_problems": schema_problems}, indent=2,
                          default=str))
     else:
@@ -160,6 +172,9 @@ def main(argv=None) -> int:
         if any(r.get("lineage") for r in rows):
             print("\n## Restart lineage (stitched segments)\n")
             print(R.render_lineage(rows))
+        for doc, src in chaos_docs:
+            print(f"\n## Chaos campaign — {src}\n")
+            print(R.render_chaos(doc))
         if any(r.get("ledger_aggregates") for r in rows):
             print("\n## Collective bus bandwidth (ledger vs roofline vs "
                   "NCCL reference)\n")

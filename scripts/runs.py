@@ -12,6 +12,9 @@ database and answer the questions artifact-grepping can't —
     (collective kind, payload bucket, mesh axis) an autotuner can use
     as its communication cost table.  ``load_cost_model`` round-trips
     it back for consumers.
+  * ``chaos``   — tabulate chaos campaign cells (scripts/chaos.py);
+    ``index`` picks up a ``chaos_report.json`` sitting in the results
+    root (or passed explicitly) into the ``chaos_cells`` table.
 
 The database is disposable — ``index`` rebuilds rows from the run-dir
 artifacts, which remain the source of truth.
@@ -80,6 +83,17 @@ CREATE TABLE IF NOT EXISTS ledger_aggregates (
     busbw_gbps     REAL,
     PRIMARY KEY (run_id, kind, payload_bucket, axis)
 );
+CREATE TABLE IF NOT EXISTS chaos_cells (
+    report       TEXT NOT NULL,
+    started_utc  TEXT,
+    cell         TEXT NOT NULL,
+    fault        TEXT,
+    strategy     TEXT,
+    status       TEXT,
+    duration_s   REAL,
+    invariants_json TEXT,
+    PRIMARY KEY (report, cell)
+);
 """
 
 
@@ -143,6 +157,26 @@ def index_run_dir(conn: sqlite3.Connection, run_dir: str) -> str | None:
     return run_id
 
 
+def index_chaos_report(conn: sqlite3.Connection, path: str) -> int:
+    """Upsert one ``chaos_report.json`` (scripts/chaos.py) into the
+    ``chaos_cells`` table; returns the number of cells indexed."""
+    doc = _load_json(Path(path))
+    if doc is None or doc.get("schema") != 1 or "cells" not in doc:
+        return 0
+    report = str(Path(path).resolve())
+    conn.execute("DELETE FROM chaos_cells WHERE report = ?", (report,))
+    for c in doc["cells"]:
+        conn.execute(
+            "INSERT OR REPLACE INTO chaos_cells VALUES "
+            "(?,?,?,?,?,?,?,?)",
+            (report, doc.get("started_utc"), c.get("cell"),
+             c.get("fault"), c.get("strategy"), c.get("status"),
+             c.get("duration_s"),
+             json.dumps(c.get("invariants") or {})))
+    conn.commit()
+    return len(doc["cells"])
+
+
 def index_results_dir(conn: sqlite3.Connection,
                       results_dir: str) -> list[str]:
     indexed = []
@@ -154,6 +188,11 @@ def index_results_dir(conn: sqlite3.Connection,
             rid = index_run_dir(conn, str(entry))
             if rid is not None:
                 indexed.append(rid)
+    if (root / "chaos_report.json").is_file():
+        n = index_chaos_report(conn, str(root / "chaos_report.json"))
+        if n:
+            print(f"[runs] indexed chaos report "
+                  f"({n} cells) from {root / 'chaos_report.json'}")
     return indexed
 
 
@@ -299,6 +338,10 @@ def load_cost_model(path: str) -> CostModel:
 def _cmd_index(conn, args) -> int:
     ids = index_results_dir(conn, args.results_dir)
     for d in args.run_dirs:
+        if Path(d).is_file() and d.endswith(".json"):
+            n = index_chaos_report(conn, d)
+            print(f"[runs] indexed chaos report ({n} cells) from {d}")
+            continue
         rid = index_run_dir(conn, d)
         if rid is not None:
             ids.append(rid)
@@ -377,6 +420,34 @@ def _cmd_diff(conn, args) -> int:
     return 1 if (args.fail_on_regression and regressed) else 0
 
 
+def _cmd_chaos(conn, args) -> int:
+    q = "SELECT * FROM chaos_cells WHERE 1=1"
+    params: list = []
+    if args.status:
+        q += " AND status = ?"
+        params.append(args.status)
+    q += " ORDER BY report, strategy, cell"
+    rows = conn.execute(q, params).fetchall()
+    if not rows:
+        print("[runs] no chaos cells indexed; `runs.py index "
+              "path/to/chaos_report.json` first")
+        return 0
+    hdr = (f"{'cell':24} {'fault':14} {'strategy':8} {'status':7} "
+           f"{'dur_s':>7}  failed invariants")
+    print(hdr)
+    print("-" * len(hdr))
+    red = 0
+    for r in rows:
+        inv = json.loads(r["invariants_json"] or "{}")
+        bad = ",".join(k for k, v in inv.items() if not v)
+        red += r["status"] != "green"
+        print(f"{r['cell']:24} {str(r['fault']):14} "
+              f"{str(r['strategy']):8} {str(r['status']):7} "
+              f"{_fmt(r['duration_s'], 1):>7}  {bad or '-'}")
+    print(f"[runs] {len(rows)} cell(s), {red} red")
+    return 1 if (args.fail_on_red and red) else 0
+
+
 def _cmd_export(conn, args) -> int:
     try:
         model = export_cost_model(conn, args.run_ids or None,
@@ -427,6 +498,13 @@ def main(argv=None) -> int:
     s.add_argument("--fail-on-regression", action="store_true",
                    help="exit 1 if any metric regressed")
 
+    s = sub.add_parser("chaos", help="tabulate indexed chaos campaign "
+                                     "cells")
+    s.add_argument("--status", type=str, default=None,
+                   help="filter by cell status (green / red)")
+    s.add_argument("--fail-on-red", action="store_true",
+                   help="exit 1 if any indexed cell is red")
+
     s = sub.add_parser("export-cost-model",
                        help="fold ledger aggregates across runs into "
                             "cost_model.json")
@@ -441,6 +519,7 @@ def main(argv=None) -> int:
     try:
         return {"index": _cmd_index, "list": _cmd_list,
                 "show": _cmd_show, "diff": _cmd_diff,
+                "chaos": _cmd_chaos,
                 "export-cost-model": _cmd_export}[args.cmd](conn, args)
     finally:
         conn.close()

@@ -67,13 +67,15 @@ def test_heartbeat_roundtrip_and_bounded_detection(tmp_path):
     one poll — the bounded-interval contract; a .dead breadcrumb is
     detected instantly; a never-started worker is judged against the
     (longer) startup grace, not the beat timeout."""
+    # monitor first — the launcher's ordering (beats written before the
+    # attempt started are stale and ignored, see the pre-seeded test)
+    mon = RZ.HeartbeatMonitor(tmp_path, 3, timeout_s=0.2,
+                              startup_grace_s=30.0)
     hb0, hb1 = RZ.Heartbeat(tmp_path, 0), RZ.Heartbeat(tmp_path, 1)
     hb0.beat(3)
     hb1.beat(3)
     beats = RZ.read_heartbeats(tmp_path)
     assert beats[0]["step"] == 3 and beats[1]["rank"] == 1
-    mon = RZ.HeartbeatMonitor(tmp_path, 3, timeout_s=0.2,
-                              startup_grace_s=30.0)
     assert mon.dead_workers() == []          # rank 2: startup grace
     t0 = time.monotonic()
     deadline = time.monotonic() + 5.0
@@ -102,6 +104,36 @@ def test_heartbeat_monitor_tolerates_stragglers(tmp_path):
     assert time.monotonic() - t0 >= 0.08
     hb.beat(1)
     assert mon.dead_workers() == []
+
+
+def test_heartbeat_monitor_ignores_preseeded_liveness_files(tmp_path):
+    """A heartbeat dir recycled across launcher attempts starts
+    pre-seeded with the PREVIOUS attempt's files.  A stale ``.dead``
+    breadcrumb must not condemn a worker that is alive now, and a stale
+    beat must not vouch for one that never re-started — liveness files
+    whose mtime predates the monitor's attempt start are ignored, and
+    only files written during THIS attempt are judged."""
+    hb0, hb1 = RZ.Heartbeat(tmp_path, 0), RZ.Heartbeat(tmp_path, 1)
+    hb0.mark_dead("kill_worker@3")           # last attempt's breadcrumb
+    hb1.beat(7)                              # last attempt's final beat
+    past = time.time() - 3600.0
+    for p in tmp_path.iterdir():
+        os.utime(p, (past, past))
+    mon = RZ.HeartbeatMonitor(tmp_path, 2, timeout_s=0.2,
+                              startup_grace_s=30.0)
+    # the stale breadcrumb is ignored, and rank 1's stale beat counts
+    # as never-beaten (judged by the 30 s startup grace, so not dead)
+    assert mon.dead_workers() == []
+    # a FRESH breadcrumb written this attempt still trips instantly
+    hb0.mark_dead("kill_worker@5")
+    assert mon.dead_workers() == [0]
+    # rank 1 beats this attempt, then goes silent past the beat timeout
+    hb1.beat(8)
+    assert 1 not in mon.dead_workers()
+    deadline = time.monotonic() + 5.0
+    while 1 not in mon.dead_workers() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert set(mon.dead_workers()) == {0, 1}
 
 
 # --------------------------------------------------------------- watchdog

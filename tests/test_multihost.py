@@ -11,13 +11,8 @@ it — proving the mesh helpers are process-count-agnostic in fact.
 """
 
 import pytest
-import os
-import socket
-import subprocess
-import sys
-from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
+pytestmark = pytest.mark.multiproc
 
 WORKER = r"""
 import os, sys
@@ -109,42 +104,14 @@ print(f"RESULT pid={pid} losses={losses[0]!r},{losses[1]!r}",
 """
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _spawn_two(worker: str, port: int):
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_PROCESSES")}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", worker, str(port), str(pid), str(REPO)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env)
-        for pid in range(2)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append(out)
-    return procs, outs
-
-
 @pytest.mark.slow  # tier-2: same machinery pinned faster elsewhere (suite-time budget, r4 verdict #8c)
-def test_two_process_fsdp_train_step():
+def test_two_process_fsdp_train_step(procs2):
     """An actual TRAINING step spanning two OS processes: the FSDP
     choreography (per-layer gathers, reduce-scatters, loss pmean) runs
     over one 8-device mesh whose halves live in different processes —
     the torchrun-contract twin exercised end-to-end, not just a psum.
     Both processes must see the SAME replicated loss."""
-    procs, outs = _spawn_two(TRAIN_WORKER, _free_port())
+    procs, outs = procs2.spawn_two(TRAIN_WORKER, procs2.free_port())
     results = []
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
@@ -155,8 +122,8 @@ def test_two_process_fsdp_train_step():
     assert results[0] == results[1], results  # replicated loss agrees
 
 
-def test_two_process_psum():
-    procs, outs = _spawn_two(WORKER, _free_port())
+def test_two_process_psum(procs2):
+    procs, outs = procs2.spawn_two(WORKER, procs2.free_port())
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
         assert f"RESULT pid={pid} sum=6" in out, out
