@@ -367,6 +367,64 @@ def _failure_row(name: str, e: Exception,
     return row
 
 
+def _autotuned_row(model_name: str, seq: int, base_batch: int,
+                   rows: list[dict]) -> dict | None:
+    """The closed-loop tuner as one more matrix row.  The tuner's cost
+    model (``distributed_training_sandbox_tpu/tuner``) is seeded with
+    THIS run's measured rows as priors and ranks the explicit-FSDP knob
+    points the matrix covered; its stage-4 "measurement" then reuses
+    the matrix's own timed numbers — zero extra compiles — and the row
+    reports the tuner's argmax, so it ties or beats every hand-written
+    row it covers by construction while recording whether the
+    pre-measurement ranking already had the winner on top."""
+    import jax
+    from distributed_training_sandbox_tpu.memory_plan.planner import (
+        parse_bench_config_name)
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.tuner import (TunerCandidate,
+                                                        TunerCostModel)
+    from distributed_training_sandbox_tpu.tuner.knobs import KnobSpace
+    covered: dict[str, tuple] = {}
+    priors = []
+    for r in rows:
+        name = r.get("config")
+        if not name or r.get("error") or r.get("skipped") \
+                or not r.get("tokens_per_sec"):
+            continue
+        knobs = parse_bench_config_name(str(name))
+        if not knobs:
+            continue
+        covered[str(name)] = (TunerCandidate(
+            batch_scale=knobs["batch_scale"],
+            remat_policy=knobs["remat_policy"],
+            matmul_precision=knobs["matmul_precision"],
+            state_precision=knobs["state_precision"]), r)
+        if r.get("tflops_per_device"):
+            priors.append({**r, "knobs": knobs})
+    if not covered:
+        return None
+    ws = len(jax.devices())
+    pdb1 = max(-(-base_batch // ws), 1)   # per-device batch at scale 1
+    cost = TunerCostModel(priors=priors)
+    ranked = cost.rank([c for c, _ in covered.values()],
+                       getattr(T, model_name), seq=seq,
+                       base_batch=pdb1, ws=ws)
+    chosen_name, (_, chosen) = max(
+        covered.items(), key=lambda kv: kv[1][1]["tokens_per_sec"])
+    row = {"config": "autotuned",
+           **{k: v for k, v in chosen.items()
+              if k not in ("config", "ledger")},
+           "chosen_from": chosen_name, "re_measured": False,
+           "tuner": {"covered": sorted(covered),
+                     "predicted_best": ranked[0][1]["config"]
+                     if ranked else None,
+                     "predicted_hit": bool(
+                         ranked and ranked[0][1]["config"] == chosen_name),
+                     "knob_space_hash": KnobSpace().space_hash(),
+                     "cost_model_hash": cost.hash()}}
+    return row
+
+
 def run_matrix(model_name: str, seq: int, base_batch: int):
     """Measure every knob row.  Each row is pre-flighted through the
     analytic waterline predictor: predicted-over-capacity configs are
@@ -397,6 +455,14 @@ def run_matrix(model_name: str, seq: int, base_batch: int):
                             else {})})
         except Exception as e:  # noqa: BLE001 - every row must report
             rows.append(_failure_row(name, e, pred))
+        print(f"[bench] {rows[-1]}", file=sys.stderr, flush=True)
+    try:
+        auto = _autotuned_row(model_name, seq, base_batch, rows)
+    except Exception as e:  # noqa: BLE001 - the tuner row must not kill the matrix
+        auto = {"config": "autotuned",
+                "error": f"{type(e).__name__}: {str(e)[:200]}"}
+    if auto is not None:
+        rows.append(auto)
         print(f"[bench] {rows[-1]}", file=sys.stderr, flush=True)
     _gate_ledger_rows(rows)
     return rows

@@ -111,10 +111,30 @@ def build_parser() -> argparse.ArgumentParser:
 
     lst = sub.add_parser("list", help="known strategies and past runs")
     _add_common(lst)
+
+    # closed-loop autotuner: `dts-launch tune ...` forwards everything
+    # after the subcommand to scripts/tune.py (enumerate / prune / rank /
+    # measure -> plan.json; --check = the CI staleness gate)
+    tune = sub.add_parser(
+        "tune", add_help=False,
+        help="autotune knobs -> plan.json (scripts/tune.py)")
+    tune.add_argument("tune_args", nargs=argparse.REMAINDER,
+                      help="args for scripts/tune.py (see its --help)")
     return p
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv[:1] == ["tune"]:
+        # forward verbatim (incl. --help) to the tuner entry point
+        import importlib.util
+        tune_py = Path(__file__).resolve().parents[2] / "scripts" / \
+            "tune.py"
+        spec = importlib.util.spec_from_file_location("_dts_tune",
+                                                      tune_py)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main([a for a in argv[1:] if a != "--"])
     args = build_parser().parse_args(argv)
     cfg = _build_config(args)
 

@@ -145,6 +145,13 @@ def run_row(rec: dict) -> dict:
         row["overlap_fraction"] = sp["overlap_fraction"]
     if summ.get("host_sync_count") is not None:
         row["host_sync_count"] = summ["host_sync_count"]
+    # tuner verdict (tuner.plan_manifest_stamp): present only on runs
+    # that replayed a plan via --plan — rendered as its own section so
+    # every replay is traceable back to the plan that chose its knobs
+    tuner = (man.get("extra") or {}).get("tuner") \
+        or cfg.get("tuner") or summ.get("tuner")
+    if tuner is not None:
+        row["tuner"] = tuner
     # serving SLO block (serving.ServingEngine.slo_report, filed by
     # scripts/serve_bench.py) — rendered as its own section
     if summ.get("serving") is not None:
@@ -333,6 +340,36 @@ def render_serving(rows: list[dict]) -> str:
             f"| {_fmt(pool.get('peak_util'), '.2f')} "
             f"| {'0 ✓' if rt == 0 else _fmt(rt, 'd') if rt is not None else '—'} "
             f"| {mode} |")
+    return "\n".join(out)
+
+
+# ----------------------------------------------------------------- tuner
+
+def render_tuner(rows: list[dict]) -> str:
+    """Tuner-verdict table for every run that replayed a plan
+    (``tuner.plan_manifest_stamp`` stamped via a driver's ``--plan``):
+    the chosen candidate, the plan's provenance hashes, and predicted
+    vs this run's numbers — the closed loop made visible."""
+    trows = [r for r in rows if r.get("tuner")]
+    if not trows:
+        return "_no plan-replayed runs_"
+    out = ["| run | plan | objective | chosen | knob space | cost model "
+           "| predicted tok/s | plan-measured tok/s | this run tok/s |",
+           "|---|---|---|---|---|---|---|---|---|"]
+    for r in sorted(trows, key=lambda r: r.get("run_id") or ""):
+        t = r["tuner"]
+        pred = t.get("predicted") or {}
+        meas = t.get("measured") or {}
+        out.append(
+            f"| {r.get('run_id', '—')} "
+            f"| {t.get('plan') or '—'} "
+            f"| {t.get('objective') or '—'} "
+            f"| {t.get('chosen') or '—'} "
+            f"| {t.get('knob_space_hash') or '—'} "
+            f"| {t.get('cost_model_hash') or '—'} "
+            f"| {_fmt(pred.get('predicted_tokens_per_sec'), '.1f')} "
+            f"| {_fmt(meas.get('tokens_per_sec'), '.1f')} "
+            f"| {_fmt(r.get('tokens_per_second'), '.1f')} |")
     return "\n".join(out)
 
 

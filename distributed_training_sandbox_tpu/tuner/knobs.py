@@ -1,0 +1,220 @@
+"""Declarative knob space: the axes ``bench.py:KNOB_MATRIX``
+hand-enumerates, as data.
+
+A :class:`TunerCandidate` is one point — a superset of the memory
+planner's :class:`~..memory_plan.planner.Candidate` (which covers the
+per-step knobs) extended with the driver-level knobs the planner never
+sees: batch scale, the overlap engine mode, sync cadence, and DDP bucket
+size.  A :class:`KnobSpace` is a cross product of named axes with the
+same feasibility rules the step factories enforce, so enumeration never
+emits a candidate the drivers would reject.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from dataclasses import asdict, dataclass
+
+from ..memory_plan.planner import REMAT_POLICIES
+
+
+@dataclass(frozen=True)
+class TunerCandidate:
+    """One point of the tuner's knob space."""
+    strategy: str = "fsdp"
+    batch_scale: int = 1
+    accum_steps: int = 1
+    remat_policy: str = "full"
+    matmul_precision: str = "bf16"
+    state_precision: str = "full"
+    offload: str = "none"
+    overlap: str = "none"          # "none" | "ring" | "ring_fused"
+    sync_every: int = 0            # 0 = pump default (no per-step sync)
+    bucket_mb: float | None = None  # DDP-family bucket size
+
+    # ------------------------------------------------------------ names
+    def bench_name(self) -> str:
+        """The ``bench.py`` row name for this candidate, in the grammar
+        ``parse_bench_config_name`` reads back (explicit[_remat][_int8_bwd]
+        [_s8][_b{N}x]).  Knobs the bench grammar has no token for
+        (accum, offload, overlap, sync) get trailing tags — such names
+        parse to None, which is correct: no measured bench row covers
+        them."""
+        parts = ["explicit"]
+        if self.remat_policy != "full":
+            parts.append(self.remat_policy)
+        if self.matmul_precision == "int8_bwd":
+            parts.append("int8_bwd")
+        if self.state_precision == "int8":
+            parts.append("s8")
+        if self.batch_scale > 1:
+            parts.append(f"b{self.batch_scale}x")
+        if self.accum_steps > 1:
+            parts.append(f"accum{self.accum_steps}")
+        if self.offload != "none":
+            parts.append(f"offload_{self.offload}")
+        if self.overlap != "none":
+            parts.append(self.overlap)
+        if self.sync_every:
+            parts.append(f"sync{self.sync_every}")
+        return "_".join(parts)
+
+    def label(self) -> str:
+        return self.bench_name()
+
+    # -------------------------------------------------- driver adapters
+    def cfg_overrides(self) -> dict:
+        """``TransformerConfig`` overrides (``dataclasses.replace``)."""
+        over = {"remat_policy": self.remat_policy,
+                "matmul_precision": self.matmul_precision}
+        if self.offload == "opt_act":
+            over["offload_activations"] = True
+        return over
+
+    def step_kwargs(self) -> dict:
+        """``fsdp.make_fsdp_train_step`` kwargs for this candidate."""
+        kw: dict = {"reshard_after_forward": True}
+        if self.accum_steps > 1:
+            kw["accum_steps"] = self.accum_steps
+        if self.state_precision != "full":
+            kw["state_precision"] = self.state_precision
+        if self.offload != "none":
+            kw["offload"] = self.offload
+        if self.overlap != "none":
+            kw["overlap"] = self.overlap
+        return kw
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TunerCandidate":
+        return cls(**{k: d[k] for k in cls.__dataclass_fields__
+                      if k in d})
+
+
+# default axes: the envelope of every hand-written KNOB_MATRIX row plus
+# the planner-only knobs (accum, offload) the matrix never swept
+_DEFAULT_AXES = dict(
+    strategy=("fsdp",),
+    batch_scale=(1, 2, 4, 8),
+    accum_steps=(1, 2),
+    remat_policy=REMAT_POLICIES,
+    matmul_precision=("bf16", "int8_bwd"),
+    state_precision=("full", "int8"),
+    offload=("none", "opt"),
+    overlap=("none",),
+    sync_every=(0,),
+    bucket_mb=(None,),
+)
+
+
+@dataclass(frozen=True)
+class KnobSpace:
+    """Cross product of knob axes with the step factories' feasibility
+    rules applied at enumeration time.  Frozen + tuple-valued so the
+    space itself is hashable content: :meth:`space_hash` is the
+    provenance stamp a ``plan.json`` carries."""
+    strategy: tuple = _DEFAULT_AXES["strategy"]
+    batch_scale: tuple = _DEFAULT_AXES["batch_scale"]
+    accum_steps: tuple = _DEFAULT_AXES["accum_steps"]
+    remat_policy: tuple = _DEFAULT_AXES["remat_policy"]
+    matmul_precision: tuple = _DEFAULT_AXES["matmul_precision"]
+    state_precision: tuple = _DEFAULT_AXES["state_precision"]
+    offload: tuple = _DEFAULT_AXES["offload"]
+    overlap: tuple = _DEFAULT_AXES["overlap"]
+    sync_every: tuple = _DEFAULT_AXES["sync_every"]
+    bucket_mb: tuple = _DEFAULT_AXES["bucket_mb"]
+
+    def axes(self) -> dict:
+        return {k: list(getattr(self, k))
+                for k in _DEFAULT_AXES}
+
+    def space_hash(self) -> str:
+        """sha256 over the canonical JSON of the axes — two spaces with
+        the same axes hash identically regardless of construction."""
+        blob = json.dumps(self.axes(), sort_keys=True, default=str)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def enumerate(self, per_device_batch: int) -> list[TunerCandidate]:
+        """Every feasible candidate, in a deterministic (sorted-axes
+        cross-product) order.  Feasibility = the step factories' own
+        rules: accumulation must divide the per-device batch at that
+        candidate's scale; activation offload needs a named-save remat
+        policy (same rule as ``memory_plan.enumerate_candidates``)."""
+        out = []
+        for bs in self.batch_scale:
+            pdb = max(per_device_batch, 1) * bs
+            for strat in self.strategy:
+                for a in self.accum_steps:
+                    if a < 1 or (pdb % a):
+                        continue
+                    for r in self.remat_policy:
+                        for q in self.matmul_precision:
+                            for s in self.state_precision:
+                                for o in self.offload:
+                                    if o == "opt_act" and r not in (
+                                            "save_attn", "save_dots_q8"):
+                                        continue
+                                    for ov in self.overlap:
+                                        for se in self.sync_every:
+                                            for bm in self.bucket_mb:
+                                                out.append(TunerCandidate(
+                                                    strat, bs, a, r, q, s,
+                                                    o, ov, se, bm))
+        return out
+
+    def sample(self, n: int, seed: int,
+               per_device_batch: int = 1) -> list[TunerCandidate]:
+        """Deterministic sample of the feasible space — the same seed
+        yields the same candidates on every host/run."""
+        cands = self.enumerate(per_device_batch)
+        if n >= len(cands):
+            return cands
+        return random.Random(seed).sample(cands, n)
+
+    @classmethod
+    def from_axes(cls, axes: dict) -> "KnobSpace":
+        kw = {k: tuple(v) for k, v in axes.items()
+              if k in _DEFAULT_AXES}
+        return cls(**kw)
+
+
+@dataclass(frozen=True)
+class ServingKnobSpace:
+    """The serving-pool half of the knob space (objective = p99
+    latency): the ``ServingEngine`` pool knobs ``serve_bench.py``
+    exposes as flags."""
+    max_batch: tuple = (2, 4, 8)
+    page_size: tuple = (4, 8, 16)
+    prefill_chunk: tuple = (8, 16, 32)
+    sync_every: tuple = (2, 4, 8)
+
+    def axes(self) -> dict:
+        return {k: list(getattr(self, k))
+                for k in ("max_batch", "page_size", "prefill_chunk",
+                          "sync_every")}
+
+    def space_hash(self) -> str:
+        blob = json.dumps(self.axes(), sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def enumerate(self) -> list[dict]:
+        out = []
+        for mb in self.max_batch:
+            for ps in self.page_size:
+                for pc in self.prefill_chunk:
+                    for se in self.sync_every:
+                        out.append({"max_batch": mb, "page_size": ps,
+                                    "prefill_chunk": pc,
+                                    "sync_every": se})
+        return out
+
+    @classmethod
+    def from_axes(cls, axes: dict) -> "ServingKnobSpace":
+        kw = {k: tuple(v) for k, v in axes.items()
+              if k in ("max_batch", "page_size", "prefill_chunk",
+                       "sync_every")}
+        return cls(**kw)

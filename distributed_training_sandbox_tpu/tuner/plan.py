@@ -1,0 +1,141 @@
+"""``plan.json``: the tuner's versioned, replayable output.
+
+A plan records WHAT was chosen (the candidate's knobs), WHY (predicted
+and measured numbers for everything enumerated, pruned, ranked, and
+measured), and FROM WHAT (provenance hashes of the knob space, the cost
+model, and the bench priors) — so a driver can replay the choice
+exactly and CI can detect a plan gone stale against the code that would
+re-derive it (``scripts/tune.py --check``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+PLAN_SCHEMA = 1
+
+
+def save_plan(doc: dict, path: str) -> None:
+    doc = dict(doc)
+    doc.setdefault("schema_version", PLAN_SCHEMA)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=False, default=str)
+        f.write("\n")
+
+
+def load_plan(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    ver = doc.get("schema_version")
+    if ver != PLAN_SCHEMA:
+        raise ValueError(
+            f"{path}: plan schema_version {ver!r} != {PLAN_SCHEMA} — "
+            f"re-run scripts/tune.py")
+    if not isinstance(doc.get("chosen"), dict) \
+            or "knobs" not in doc["chosen"]:
+        raise ValueError(f"{path}: plan has no chosen.knobs")
+    return doc
+
+
+def check_plan(doc: dict, *, space=None, cost=None) -> dict:
+    """Staleness verdict for a committed plan against the CURRENT code
+    and artifacts.  ``space``/``cost`` default to the plan's own
+    objective-appropriate knob space rebuilt from today's defaults and
+    a :class:`~.cost.TunerCostModel` loaded from the plan's recorded
+    artifact paths.  Returns ``{"stale": bool, "reasons": [...]}``."""
+    from .cost import TunerCostModel
+    from .knobs import KnobSpace, ServingKnobSpace
+    reasons = []
+    if space is None:
+        if doc.get("objective") == "p99_latency":
+            space = ServingKnobSpace()
+        else:
+            space = KnobSpace()
+    cur_space = space.space_hash()
+    if doc.get("knob_space_hash") != cur_space:
+        reasons.append(
+            f"knob space drifted: plan {doc.get('knob_space_hash')} "
+            f"vs current {cur_space}")
+    if cost is None:
+        prov = doc.get("provenance") or {}
+        cost = TunerCostModel.from_artifacts(
+            cost_model_path=prov.get("cost_model_path"),
+            prior_paths=prov.get("prior_paths"))
+    cur_cost = cost.hash()
+    if doc.get("cost_model_hash") != cur_cost:
+        reasons.append(
+            f"cost model / priors drifted: plan "
+            f"{doc.get('cost_model_hash')} vs current {cur_cost}")
+    return {"stale": bool(reasons), "reasons": reasons,
+            "knob_space_hash": cur_space, "cost_model_hash": cur_cost}
+
+
+# -------------------------------------------------------- driver adapters
+
+def plan_cfg_overrides(doc: dict) -> dict:
+    """``TransformerConfig`` overrides for the chosen candidate (the
+    FSDP-family driver path)."""
+    from .knobs import TunerCandidate
+    return TunerCandidate.from_dict(doc["chosen"]["knobs"]).cfg_overrides()
+
+
+def plan_step_kwargs(doc: dict) -> dict:
+    """``fsdp.make_fsdp_train_step`` kwargs for the chosen candidate."""
+    from .knobs import TunerCandidate
+    return TunerCandidate.from_dict(doc["chosen"]["knobs"]).step_kwargs()
+
+
+def plan_train_overrides(doc: dict, base_batch_size: int | None = None
+                         ) -> dict:
+    """``TrainConfig``-level overrides for the chosen candidate: the
+    knobs the strategy drivers (``_zero_driver``/``_2d_driver``) thread
+    through ``TrainConfig`` rather than the step factory.  Only knobs
+    the plan actually moves off their defaults appear, so a driver's
+    own flags keep working for everything the plan doesn't set."""
+    k = doc["chosen"]["knobs"]
+    over: dict = {}
+    bs = int(k.get("batch_scale", 1))
+    if bs > 1 and base_batch_size:
+        over["batch_size"] = base_batch_size * bs
+    if int(k.get("accum_steps", 1)) > 1:
+        over["accum_steps"] = int(k["accum_steps"])
+    if k.get("sync_every"):
+        over["sync_every"] = int(k["sync_every"])
+    if k.get("overlap", "none") != "none":
+        over["overlap"] = k["overlap"]
+    if k.get("offload", "none") != "none":
+        over["offload"] = k["offload"]
+    if k.get("bucket_mb") is not None:
+        over["bucket_mb"] = float(k["bucket_mb"])
+    return over
+
+
+def apply_plan_to_train_config(doc: dict, cfg):
+    """One-call form: the driver's ``TrainConfig`` with the plan's
+    overrides applied (batch scaled off the cfg's own batch_size)."""
+    over = plan_train_overrides(doc, base_batch_size=cfg.batch_size)
+    return dataclasses.replace(cfg, **over) if over else cfg
+
+
+def plan_serving_knobs(doc: dict) -> dict:
+    """ServingEngine pool knobs for a p99-objective plan."""
+    return dict(doc["chosen"]["knobs"])
+
+
+def plan_manifest_stamp(doc: dict, path: str | None = None) -> dict:
+    """The tuner-verdict block a replaying driver stamps into its
+    telemetry manifest (``TelemetryRun(extra={"tuner": ...})``) — ties
+    every replayed run back to the plan that chose its knobs."""
+    chosen = doc.get("chosen") or {}
+    return {
+        "plan": str(Path(path).name) if path else None,
+        "schema_version": doc.get("schema_version"),
+        "objective": doc.get("objective"),
+        "chosen": chosen.get("config") or chosen.get("knobs"),
+        "knob_space_hash": doc.get("knob_space_hash"),
+        "cost_model_hash": doc.get("cost_model_hash"),
+        "predicted": chosen.get("predicted"),
+        "measured": chosen.get("measured"),
+    }

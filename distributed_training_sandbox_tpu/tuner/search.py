@@ -1,0 +1,253 @@
+"""Stage orchestration: enumerate → prune → rank → measure → plan.
+
+``tune()`` is the subsystem's one programmatic entry point; it never
+compiles anything outside stage 4, and stage 4 compiles at most
+``top_k`` candidates — the whole point (BENCH_r01–r05 burnt ~6 compiles
+on OOMs alone before the planner existed, and dozens measuring rows a
+cost model would have ranked out).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+from ..memory_plan.predictor import analytic_waterline
+from .cost import TunerCostModel, _planner_candidate
+from .knobs import KnobSpace, ServingKnobSpace
+from .plan import PLAN_SCHEMA
+
+_REPO = Path(__file__).resolve().parents[2]
+
+
+def _default_measure(model_name: str, seq: int, base_batch: int,
+                     ws: int, num_steps: int):
+    """bench.py's own ``measure()`` as the stage-4 harness — the same
+    timed loop the hand-written matrix rows go through, so an
+    ``autotuned`` number is comparable to every hand row by
+    construction."""
+    if str(_REPO) not in sys.path:
+        sys.path.insert(0, str(_REPO))
+    import bench
+
+    def fn(c):
+        return bench.measure(
+            model_name, seq, base_batch * c.batch_scale * ws,
+            num_steps=num_steps, cfg_overrides=c.cfg_overrides(),
+            step_kwargs=c.step_kwargs())
+    return fn
+
+
+def prune_candidates(cands, cfg, *, base_batch: int, seq: int, ws: int,
+                     capacity_gb: float | None):
+    """Stage 2: analytic waterline per candidate, pre-compile.  Returns
+    ``(survivors, pruned_rows)``; every rejected candidate is reported
+    with its predicted GB (never silently dropped).  With no capacity
+    (CPU sim exposes none and no budget was given) nothing prunes, but
+    the predictions still ride along."""
+    survivors, pruned = [], []
+    preds = {}
+    for c in cands:
+        pc = _planner_candidate(c)
+        batch = base_batch * c.batch_scale * ws
+        pred = analytic_waterline(
+            pc.apply_to(cfg), batch=batch, seq=seq, ws=ws,
+            accum_steps=c.accum_steps, state_precision=c.state_precision,
+            offload=c.offload, capacity_gb=capacity_gb)
+        preds[c] = round(pred.gb, 3)
+        if pred.fits is False:
+            pruned.append({"config": c.bench_name(),
+                           "predicted_gb": round(pred.gb, 3),
+                           "capacity_gb": round(capacity_gb, 2)})
+        else:
+            survivors.append(c)
+    return survivors, pruned, preds
+
+
+def tune(model_name: str, seq: int, base_batch: int, *,
+         objective: str = "throughput", space=None,
+         budget_gb: float | None = None, top_k: int = 5,
+         num_steps: int = 4, cost_model_path: str | None = None,
+         prior_paths: list | None = None, measure_fn=None,
+         cost: TunerCostModel | None = None, log=None) -> dict:
+    """Run all four stages and return the plan document (the caller
+    decides whether to ``save_plan`` it).  ``top_k=0`` stops after
+    ranking (no compiles) — the transfer-prediction mode where the
+    chosen candidate is the predicted argmax.  ``base_batch`` is the
+    per-device batch at scale 1; global batch for a candidate is
+    ``base_batch × batch_scale × ws``."""
+    import jax
+    log = log or (lambda *a: None)
+    if objective == "p99_latency":
+        return _tune_serving(space, top_k=top_k, log=log)
+    if objective != "throughput":
+        raise ValueError(f"unknown objective {objective!r}")
+
+    from ..models import transformer as T
+    from ..utils.memory import hbm_capacity_gb
+    cfg = getattr(T, model_name)
+    ws = len(jax.devices())
+    space = space or KnobSpace()
+    if cost is None:
+        cost = TunerCostModel.from_artifacts(
+            cost_model_path=cost_model_path, prior_paths=prior_paths)
+
+    # 1. enumerate
+    cands = space.enumerate(base_batch)
+    log(f"[tune] stage 1: {len(cands)} candidates from the knob space")
+
+    # 2. prune
+    capacity = budget_gb if budget_gb is not None else hbm_capacity_gb()
+    survivors, pruned, preds = prune_candidates(
+        cands, cfg, base_batch=base_batch, seq=seq, ws=ws,
+        capacity_gb=capacity)
+    log(f"[tune] stage 2: {len(pruned)} pruned analytically "
+        f"(capacity {capacity} GB), {len(survivors)} survive")
+
+    # 3. rank
+    ranked = cost.rank(survivors, cfg, seq=seq, base_batch=base_batch,
+                       ws=ws)
+    ranking_rows = [{**pred, "predicted_gb": preds[c],
+                     "knobs": c.to_dict()} for c, pred in ranked]
+    log(f"[tune] stage 3: ranked {len(ranked)} "
+        f"(top: {ranking_rows[0]['config'] if ranking_rows else '-'})")
+
+    # 4. measure top-k
+    measured, compiles = [], 0
+    if top_k > 0 and ranked:
+        fn = measure_fn or _default_measure(model_name, seq, base_batch,
+                                            ws, num_steps)
+        for c, pred in ranked[:top_k]:
+            t0 = time.perf_counter()
+            try:
+                row = fn(c)
+            except Exception as e:  # noqa: BLE001 - a row must not kill the plan
+                row = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+            compiles += 1
+            measured.append({"config": c.bench_name(),
+                             "knobs": c.to_dict(), "predicted": pred,
+                             "measure_s": round(
+                                 time.perf_counter() - t0, 2), **row})
+            log(f"[tune] stage 4: {c.bench_name()} -> "
+                f"{row.get('tflops_per_device', row.get('error'))}")
+
+    good = [m for m in measured if "error" not in m]
+    if good:
+        best = max(good, key=lambda m: m.get("tokens_per_sec") or 0.0)
+        chosen = {"config": best["config"], "knobs": best["knobs"],
+                  "predicted": best["predicted"],
+                  "measured": {k: best[k] for k in
+                               ("tokens_per_sec", "step_ms",
+                                "tflops_per_device")
+                               if k in best}}
+    elif ranking_rows:
+        top = ranking_rows[0]
+        chosen = {"config": top["config"], "knobs": top["knobs"],
+                  "predicted": {k: top[k] for k in top
+                                if k not in ("knobs",)},
+                  "measured": None}
+    else:
+        chosen = None
+
+    return {
+        "schema_version": PLAN_SCHEMA,
+        "objective": objective,
+        "model": model_name, "seq": seq, "base_batch": base_batch,
+        "devices": ws, "platform": jax.devices()[0].platform,
+        "knob_space": space.axes(),
+        "knob_space_hash": space.space_hash(),
+        "cost_model_hash": cost.hash(),
+        "priors_hash": cost.priors_hash(),
+        "provenance": {"cost_model_path": cost.cost_model_path,
+                       "prior_paths": cost.prior_paths},
+        "budget_gb": capacity,
+        "enumerated": len(cands),
+        "pruned": pruned,
+        "ranking": ranking_rows,
+        "measured": measured,
+        "compiles_spent": compiles,
+        "chosen": chosen,
+    }
+
+
+# ------------------------------------------------------------- serving
+
+def _serving_proxy(k: dict) -> float:
+    """Heuristic pre-measurement ordering for pool knobs (measurement
+    decides among the top-k; this only picks WHICH k to measure): more
+    decode slots amortize the per-step scheduler overhead, bigger
+    prefill chunks cut TTFT chunking stalls, tighter sync cadence costs
+    host round-trips."""
+    return (k["max_batch"] * 1.0 + k["prefill_chunk"] / 32.0
+            - 4.0 / max(k["sync_every"], 1) - k["page_size"] / 64.0)
+
+
+def _measure_serving_knobs(knobs: dict, n_requests: int = 16) -> dict:
+    """Closed seeded burst through the real ServingEngine — the p99
+    objective's stage-4 harness (mirrors ``bench.measure_serving``)."""
+    import numpy as np
+    import jax
+    from ..models import transformer as T
+    from ..serving import ServingEngine
+    cfg = T.TINY_LM
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(0)
+    eng = ServingEngine(params, cfg, max_seq_len=64, **knobs)
+    for _ in range(n_requests):
+        plen = int(rng.integers(4, 25))
+        prompt = rng.integers(1, cfg.vocab_size,
+                              size=plen).astype("int32")
+        eng.submit(prompt, max_new_tokens=int(rng.integers(4, 13)))
+    t0 = time.perf_counter()
+    eng.run()
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    slo = eng.slo_report()
+    return {"wall_ms": round(wall_ms, 1),
+            "p99_ttft_ms": slo.get("ttft_ms", {}).get("p99"),
+            "p99_per_token_ms": slo.get("per_token_ms", {}).get("p99"),
+            "tokens_per_s": slo.get("tokens_per_s")}
+
+
+def _tune_serving(space, *, top_k: int, log) -> dict:
+    import jax
+    space = space or ServingKnobSpace()
+    cands = space.enumerate()
+    log(f"[tune] serving: {len(cands)} pool-knob candidates")
+    ranked = sorted(cands, key=_serving_proxy, reverse=True)
+    measured = []
+    for k in ranked[:max(top_k, 1)]:
+        try:
+            row = _measure_serving_knobs(k)
+        except Exception as e:  # noqa: BLE001 - a row must not kill the plan
+            row = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+        measured.append({"knobs": k, **row})
+        log(f"[tune] serving {k} -> "
+            f"p99/token {row.get('p99_per_token_ms', row.get('error'))}")
+    good = [m for m in measured
+            if "error" not in m and m.get("p99_per_token_ms")]
+    chosen = None
+    if good:
+        best = min(good, key=lambda m: m["p99_per_token_ms"])
+        chosen = {"config": "serving_pool", "knobs": best["knobs"],
+                  "predicted": {"proxy": _serving_proxy(best["knobs"])},
+                  "measured": {k: best[k] for k in
+                               ("p99_ttft_ms", "p99_per_token_ms",
+                                "tokens_per_s")}}
+    return {
+        "schema_version": PLAN_SCHEMA,
+        "objective": "p99_latency",
+        "model": "TINY_LM", "devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform,
+        "knob_space": space.axes(),
+        "knob_space_hash": space.space_hash(),
+        "cost_model_hash": "serving_proxy_v1",
+        "enumerated": len(cands),
+        "pruned": [],
+        "ranking": [{"knobs": k,
+                     "proxy": round(_serving_proxy(k), 3)}
+                    for k in ranked],
+        "measured": measured,
+        "compiles_spent": len(measured),
+        "chosen": chosen,
+    }

@@ -33,6 +33,10 @@ def run(mode: str, argv=None):
     p.add_argument("--model", choices=sorted(MODELS), default="tiny")
     p.add_argument(f"--{mode}", type=int, default=2, dest="second",
                    help=f"size of the {mode} mesh axis (dp gets the rest)")
+    p.add_argument("--plan", default=None, metavar="PLAN_JSON",
+                   help="replay a tuner plan (scripts/tune.py): its "
+                        "TrainConfig-level knobs override this "
+                        "driver's flags")
     args, rest = p.parse_known_args(argv)
 
     if args.cpu_devices:
@@ -44,13 +48,22 @@ def run(mode: str, argv=None):
 
     cfg = TrainConfig.from_args(
         rest, sequence_length=256 if args.model == "tiny" else 8192)
+    plan = None
+    if args.plan:
+        from distributed_training_sandbox_tpu.tuner import (
+            apply_plan_to_train_config, load_plan)
+        doc = load_plan(args.plan)
+        cfg = apply_plan_to_train_config(doc, cfg)
+        plan = (doc, args.plan)
+        print(f"[train_{mode}] replaying plan {args.plan}: "
+              f"{doc['chosen']['config']} (batch {cfg.batch_size})")
     sup = RZ.Supervisor.from_config(
         cfg, strategy=f"train_{mode}",
         extra_fingerprint={"model": args.model, mode: args.second})
-    return sup.run(lambda ctx: _leg(mode, args, rest, cfg, ctx))
+    return sup.run(lambda ctx: _leg(mode, args, rest, cfg, ctx, plan))
 
 
-def _leg(mode, args, rest, cfg, ctx):
+def _leg(mode, args, rest, cfg, ctx, plan=None):
     import itertools
 
     import jax
@@ -164,12 +177,17 @@ def _leg(mode, args, rest, cfg, ctx):
     batch_spec = P("dp", "sp") if mode == "sp" else P("dp")
     pref = DevicePrefetcher(batches, mesh=mesh, spec=batch_spec,
                             depth=cfg.prefetch_depth)
+    tuner_stamp = {}
+    if plan is not None:
+        from distributed_training_sandbox_tpu.tuner import (
+            plan_manifest_stamp)
+        tuner_stamp = {"tuner": plan_manifest_stamp(plan[0], plan[1])}
     with pref, TelemetryRun(
             name, config=cfg, mesh=mesh, model=args.model,
             collective_counts=counts, profiler=prof,
             contract=verdict.to_dict(),
             lineage=ctx.manifest_lineage(),
-            extra={mode: second}) as telem:
+            extra={mode: second, **tuner_stamp}) as telem:
         pref.spans = telem.spans   # prefetch waits onto the timeline
         pref.metrics = telem.metrics
         with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,

@@ -109,6 +109,11 @@ def run_zero_ab(stage: int, argv=None):
                    help="divide the 10k toy width by this")
     p.add_argument("--rebuild", choices=["broadcast", "all_gather"],
                    default="broadcast")
+    p.add_argument("--plan", default=None, metavar="PLAN_JSON",
+                   help="replay a tuner plan (scripts/tune.py): its "
+                        "TrainConfig-level knobs (batch scale, accum, "
+                        "sync cadence, overlap, offload, buckets) "
+                        "override this driver's flags")
     args, rest = p.parse_known_args(argv)
     if args.cpu_devices:
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
@@ -118,13 +123,22 @@ def run_zero_ab(stage: int, argv=None):
     from distributed_training_sandbox_tpu import resilience as RZ
 
     cfg = TrainConfig.from_args(rest, batch_size=16)
+    plan = None
+    if args.plan:
+        from distributed_training_sandbox_tpu.tuner import (
+            apply_plan_to_train_config, load_plan)
+        doc = load_plan(args.plan)
+        cfg = apply_plan_to_train_config(doc, cfg)
+        plan = (doc, args.plan)
+        print(f"[zero{stage}] replaying plan {args.plan}: "
+              f"{doc['chosen']['config']} (batch {cfg.batch_size})")
     sup = RZ.Supervisor.from_config(
         cfg, strategy=f"zero{stage}",
         extra_fingerprint={"scale": args.scale, "rebuild": args.rebuild})
-    return sup.run(lambda ctx: _zero_ab_leg(stage, args, cfg, ctx))
+    return sup.run(lambda ctx: _zero_ab_leg(stage, args, cfg, ctx, plan))
 
 
-def _zero_ab_leg(stage, args, cfg, root_ctx):
+def _zero_ab_leg(stage, args, cfg, root_ctx, plan=None):
     import jax
     import numpy as np
     from distributed_training_sandbox_tpu.utils import (
@@ -180,6 +194,14 @@ def _zero_ab_leg(stage, args, cfg, root_ctx):
 
     from distributed_training_sandbox_tpu.telemetry import TelemetryRun
 
+    # a replayed plan stamps its tuner verdict into both legs' manifests
+    # so the run is traceable back to the plan that chose its knobs
+    tuner_stamp = {}
+    if plan is not None:
+        from distributed_training_sandbox_tpu.tuner import (
+            plan_manifest_stamp)
+        tuner_stamp = {"tuner": plan_manifest_stamp(plan[0], plan[1])}
+
     # ---- leg A: baseline Adam (replicated state, DDP-style) --------------
     base_opt = optim.adam_init(params)
     base_state = (params, base_opt)
@@ -202,7 +224,8 @@ def _zero_ab_leg(stage, args, cfg, root_ctx):
                       lineage=ctx_a.manifest_lineage(),
                       profiler=make_prof("baseline"),
                       extra={"leg": "baseline", "stage": stage,
-                             "scale": args.scale}) as telem_a:
+                             "scale": args.scale,
+                             **tuner_stamp}) as telem_a:
         (_, base_opt_f), base_losses, base_dt = _time_steps(
             base_step, base_state, batch, cfg.num_steps, telem_a,
             "baseline", tokens_per_step=cfg.batch_size, cfg=cfg, ctx=ctx_a)
@@ -238,7 +261,8 @@ def _zero_ab_leg(stage, args, cfg, root_ctx):
                       profiler=make_prof("sharded"),
                       extra={"leg": "sharded", "stage": stage,
                              "scale": args.scale,
-                             "rebuild": args.rebuild}) as telem_b:
+                             "rebuild": args.rebuild,
+                             **tuner_stamp}) as telem_b:
         (shard_params_f, opt_f), shard_losses, shard_dt = _time_steps(
             step, state0, batch, cfg.num_steps, telem_b, name,
             tokens_per_step=cfg.batch_size, cfg=cfg, ctx=ctx_b)

@@ -20,9 +20,22 @@ running this unchanged on a real multi-chip slice:
 
     python scripts/busbench.py            # v5e-8: the real ICI table
 
+Cross-process (gloo) mode — the DCN-analogue roofline (ROADMAP item 2:
+the ledger keys busbw by mesh axis, and an axis that crosses the
+process boundary traverses gloo/loopback TCP, not host memcpy, so it
+needs its OWN reference column next to the single-process sweep):
+
+    python scripts/busbench.py --gloo-procs 2 --cpu-devices 4 \
+        --payloads-mb 1,4,16 --out-dir baselines
+
+spawns N real OS worker processes joined through a local coordinator
+(``utils.mesh`` DTS_* env contract, gloo CPU collectives), runs the
+same sweep over the one global mesh, and writes
+``busbench_gloo_<N>proc_<total>dev.{json,md}`` from rank 0.
+
 Usage:
   python scripts/busbench.py [--cpu-devices 8] [--payloads-mb 1,16,128]
-      [--iters 10] [--out-dir busbench_results]
+      [--iters 10] [--out-dir busbench_results] [--gloo-procs N]
 """
 
 from __future__ import annotations
@@ -45,18 +58,78 @@ ICI_CONTEXT = (
     "| A100-80GB:2 (reference fsdp) | NVLink3 | ~300 |\n")
 
 
-def make_markdown(results, platform: str, n: int) -> str:
+def _spawn_gloo_group(argv: list[str], nprocs: int) -> int:
+    """Parent of the cross-process sweep: N workers re-running this
+    script under the launcher's DTS_* env contract on a fresh local
+    coordinator port.  Workers do the measuring (rank 0 writes); the
+    parent only supervises exit codes."""
+    import os
+    import socket
+    import subprocess
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    # scrub the parent's device-count/backend env: each worker picks its
+    # own local device count via --cpu-devices
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                             "JAX_NUM_PROCESSES")}
+    # strip --gloo-procs (both "--gloo-procs N" and "=N" forms): the
+    # workers must not themselves fan out
+    args, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--gloo-procs":
+            skip = True
+            continue
+        if a.startswith("--gloo-procs="):
+            continue
+        args.append(a)
+    procs = []
+    for pid in range(nprocs):
+        env = dict(env_base,
+                   JAX_PLATFORMS="cpu",
+                   DTS_COORDINATOR=f"127.0.0.1:{port}",
+                   DTS_NUM_PROCESSES=str(nprocs),
+                   DTS_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(Path(__file__).resolve())] + args,
+            env=env))
+    rc = 0
+    for p in procs:
+        rc = rc or p.wait()
+    return rc
+
+
+def make_markdown(results, platform: str, n: int,
+                  nprocs: int = 1) -> str:
     payloads = sorted({r.payload_bytes for r in results})
     collectives = list(dict.fromkeys(r.collective for r in results))
+    title = (f"# Gloo cross-process bus-bandwidth sweep — {nprocs} "
+             f"processes, {n} devices"
+             if nprocs > 1 else
+             f"# ICI bus-bandwidth sweep — {platform}, {n} devices")
     lines = [
-        f"# ICI bus-bandwidth sweep — {platform}, {n} devices",
+        title,
         "",
         "nccl-tests accounting (`ops/busbench.py`): `algbw = payload / t`;",
         "`busbw` applies the per-collective wire factor (all_reduce "
         "2(n-1)/n, gather/scatter/all_to_all (n-1)/n, ppermute 1).",
         "",
     ]
-    if platform != "tpu":
+    if nprocs > 1:
+        lines += [
+            "> **DCN-analogue reference.** Collectives here cross the",
+            "> process boundary over the gloo transport (loopback TCP),",
+            "> the same path a cross-process mesh axis takes under the",
+            "> multi-process launcher — the reference column for ledger",
+            "> busbw on DCN-style axes, NOT an ICI number.  Real DCN",
+            "> GB/s awaits the multi-host TPU BENCH_* run (RESULTS.md).",
+            "",
+        ]
+    elif platform != "tpu":
         lines += [
             "> **HARNESS VALIDATION ONLY — simulated mesh.** These numbers",
             "> exercise the collective choreography on host memory; they",
@@ -108,7 +181,19 @@ def main(argv=None):
                         'comma-separated subset')
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--out-dir", type=str, default="busbench_results")
+    p.add_argument("--gloo-procs", type=int, default=0,
+                   help="cross-process mode: spawn N worker processes "
+                        "joined over gloo (each with --cpu-devices "
+                        "local devices) and sweep the one global mesh "
+                        "— the DCN-analogue roofline")
     args = p.parse_args(argv)
+
+    import os
+    if args.gloo_procs >= 2 and not os.environ.get("DTS_COORDINATOR"):
+        # parent of the cross-process sweep: fan out and supervise
+        return _spawn_gloo_group(
+            list(argv) if argv is not None else sys.argv[1:],
+            args.gloo_procs)
 
     if args.cpu_devices:
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
@@ -120,15 +205,22 @@ def main(argv=None):
 
     mesh = make_mesh()
     n = int(mesh.devices.size)
+    nprocs = int(jax.process_count())
+    rank0 = int(jax.process_index()) == 0
     platform = jax.devices()[0].platform
     payloads = tuple(int(float(s) * (1 << 20))
                      for s in args.payloads_mb.split(","))
-    print(f"[busbench] platform={platform} devices={n} "
-          f"payloads={[f'{p >> 20}MiB' for p in payloads]}")
+    if rank0:
+        print(f"[busbench] platform={platform} devices={n} "
+              f"processes={nprocs} "
+              f"payloads={[f'{p >> 20}MiB' for p in payloads]}")
 
     kw = {} if args.collectives == "all" else {
         "collectives": tuple(args.collectives.split(","))}
     results = run_sweep(payloads, mesh, iters=args.iters, **kw)
+    if not rank0:
+        # every rank participates in the collectives; one rank reports
+        return results
     for r in results:
         print(f"[busbench] {r.collective:15s} {r.payload_bytes >> 20:4d} MiB "
               f"{r.time_ms:8.3f} ms  algbw {r.algbw_gbps:7.2f} GB/s  "
@@ -136,11 +228,17 @@ def main(argv=None):
 
     out = Path(args.out_dir)
     out.mkdir(parents=True, exist_ok=True)
-    tag = f"busbench_{platform}_{n}dev"
-    if platform != "tpu" or n == 1:
-        # carry the caveat in the FILENAME so nobody mistakes a sim/1-chip
-        # run for the ICI deliverable (VERDICT r2 #10)
-        tag += "_harness_validation"
+    if nprocs > 1:
+        # the cross-process (DCN-analogue) reference column: collectives
+        # traverse the gloo transport, so this is a different physical
+        # path from the single-process sweep and gets its own artifact
+        tag = f"busbench_gloo_{nprocs}proc_{n}dev"
+    else:
+        tag = f"busbench_{platform}_{n}dev"
+        if platform != "tpu" or n == 1:
+            # carry the caveat in the FILENAME so nobody mistakes a
+            # sim/1-chip run for the ICI deliverable (VERDICT r2 #10)
+            tag += "_harness_validation"
     # machine-readable sweep: the dict form scripts/report.py's roofline
     # column and the bandwidth gate consume (telemetry.report.
     # load_roofline also accepts the legacy bare-list form)
@@ -148,16 +246,20 @@ def main(argv=None):
         "schema": 1,
         "platform": platform,
         "devices": n,
+        "processes": nprocs,
+        "transport": "gloo" if nprocs > 1 else "local",
         "payload_bytes": sorted({r.payload_bytes for r in results}),
-        "harness_validation": platform != "tpu" or n == 1,
+        "harness_validation": (platform != "tpu" or n == 1)
+        and nprocs == 1,
         "rows": [r.to_dict() for r in results],
     }
     (out / f"{tag}.json").write_text(json.dumps(doc, indent=2) + "\n")
-    md = make_markdown(results, platform, n)
+    md = make_markdown(results, platform, n, nprocs)
     (out / f"{tag}.md").write_text(md)
     print(f"[busbench] wrote {out / f'{tag}.json'} and {out / f'{tag}.md'}")
     return results
 
 
 if __name__ == "__main__":
-    main()
+    _r = main()
+    raise SystemExit(_r if isinstance(_r, int) else 0)

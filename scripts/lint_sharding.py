@@ -136,6 +136,32 @@ def check_ledger_run(run_dir: str) -> int:
     return 0
 
 
+def check_contract_coverage(report: dict, *, strict: bool) -> None:
+    """Registry ↔ contract cross-check: a strategy registered with
+    ``fixtures.register_strategy`` but absent from ``CONTRACTS`` is an
+    analyzer blind spot (error — a driver nobody's choreography gates);
+    a contract with no registered builder is dead weight (warning,
+    error under ``--strict``)."""
+    from distributed_training_sandbox_tpu.analysis.fixtures import (
+        contract_coverage)
+    missing, orphans = contract_coverage()
+    for s in missing:
+        print(f"[lint] coverage error: strategy {s!r} is registered "
+              f"but has no CONTRACTS entry — its collectives are "
+              f"un-gated")
+    for s in orphans:
+        print(f"[lint] coverage warn: contract {s!r} has no registered "
+              f"fixture builder — the analyzer never exercises it")
+    report["coverage"] = {"missing_contract": missing,
+                          "unregistered_fixture": orphans,
+                          "ok": not missing and not (strict and orphans)}
+    if not report["coverage"]["ok"]:
+        report["ok"] = False
+    if not missing and not orphans:
+        print(f"[lint] coverage: every registered strategy has a "
+              f"contract and vice versa")
+
+
 def main(argv=None) -> int:
     from distributed_training_sandbox_tpu.analysis.fixtures import STRATEGIES
 
@@ -176,6 +202,7 @@ def main(argv=None) -> int:
         use_cpu_devices(args.cpu_devices)
 
     report: dict = {"strategies": {}, "pitfalls": [], "ok": True}
+    check_contract_coverage(report, strict=args.strict)
 
     for name in [s for s in args.strategies.split(",") if s]:
         sub = analyze_strategy(name, skip_recompile=args.skip_recompile,

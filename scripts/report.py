@@ -162,6 +162,9 @@ def main(argv=None) -> int:
         print(f"# Telemetry report — {len(rows)} run(s) from "
               f"{', '.join(args.paths)}\n")
         print(R.render_table(rows))
+        if any(r.get("tuner") for r in rows):
+            print("\n## Tuner verdicts (plan-replayed runs)\n")
+            print(R.render_tuner(rows))
         if any(r.get("serving") for r in rows):
             print("\n## Serving SLO (TTFT / per-token latency)\n")
             print(R.render_serving(rows))

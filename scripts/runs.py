@@ -291,6 +291,9 @@ def export_cost_model(conn: sqlite3.Connection,
         e["total_us"] = round(e["total_us"], 3)
         e["bus_bytes_moved"] = round(e["bus_bytes_moved"], 1)
     return {
+        # schema_version is the pinned contract the autotuner loads
+        # against ("schema" kept as a legacy alias for older exports)
+        "schema_version": COST_MODEL_SCHEMA,
         "schema": COST_MODEL_SCHEMA,
         "runs": contributing,
         "n_runs": len(contributing),
@@ -300,13 +303,20 @@ def export_cost_model(conn: sqlite3.Connection,
 
 class CostModel:
     """Loaded ``cost_model.json``: measured bus bandwidth per
-    (collective kind, payload bucket, mesh axis)."""
+    (collective kind, payload bucket, mesh axis).  The constructor IS
+    the drift gate: ``tuner/`` loads exports only through here, so a
+    bumped or missing ``schema_version`` fails loudly instead of
+    mis-ranking silently."""
 
     def __init__(self, doc: dict):
-        if doc.get("schema") != COST_MODEL_SCHEMA:
+        ver = doc.get("schema_version", doc.get("schema"))
+        if ver != COST_MODEL_SCHEMA:
             raise ValueError(
-                f"cost model schema {doc.get('schema')!r} != "
-                f"{COST_MODEL_SCHEMA}")
+                f"cost model schema_version {ver!r} != "
+                f"{COST_MODEL_SCHEMA} — re-export with "
+                f"scripts/runs.py export-cost-model")
+        if not isinstance(doc.get("entries"), dict):
+            raise ValueError("cost model has no entries table")
         self.doc = doc
         self.entries: dict[str, dict] = doc["entries"]
         self.runs: list[str] = list(doc.get("runs", []))

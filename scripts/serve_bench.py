@@ -144,7 +144,29 @@ def main(argv=None) -> int:
     p.add_argument("--burst-ms", type=float, default=50.0,
                    help="admission controller's per-burst latency "
                         "prior (EWMA-calibrated as bursts complete)")
+    p.add_argument("--plan", default=None, metavar="PLAN_JSON",
+                   help="replay a p99-objective tuner plan "
+                        "(scripts/tune.py --objective p99_latency): "
+                        "its pool knobs override --max-batch/"
+                        "--page-size/--prefill-chunk/--sync-every")
     args = p.parse_args(argv)
+    plan = None
+    if args.plan:
+        from distributed_training_sandbox_tpu.tuner import (
+            load_plan, plan_serving_knobs)
+        doc = load_plan(args.plan)
+        if doc.get("objective") != "p99_latency":
+            print(f"[serve] --plan {args.plan} has objective "
+                  f"{doc.get('objective')!r}; serving replays "
+                  f"p99_latency plans", file=sys.stderr)
+            return 2
+        knobs = plan_serving_knobs(doc)
+        for k in ("max_batch", "page_size", "prefill_chunk",
+                  "sync_every"):
+            if k in knobs:
+                setattr(args, k, int(knobs[k]))
+        plan = (doc, args.plan)
+        print(f"[serve] replaying plan {args.plan}: {knobs}")
     # device selection must happen BEFORE the backend initializes (a
     # live backend ignores the override), hence flag-driven, not
     # count-driven: the fleet path defaults to the simulated mesh
@@ -200,6 +222,10 @@ def main(argv=None) -> int:
                "page_size": args.page_size, "tp": args.tp,
                "kv_quant": args.kv_quant,
                "disaggregate": args.disaggregate}
+    if plan is not None:
+        from distributed_training_sandbox_tpu.tuner import (
+            plan_manifest_stamp)
+        run_cfg["tuner"] = plan_manifest_stamp(plan[0], plan[1])
     prof = None
     if args.profile:
         from distributed_training_sandbox_tpu.utils.profiling import (

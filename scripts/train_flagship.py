@@ -41,7 +41,7 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
             warmup_steps: int, peak_lr: float, out_dir: Path,
             tag_suffix: str = "", data: str = "synthetic",
             ckpt_dir: str | None = None, ckpt_every: int = 0,
-            resume: bool = False) -> dict:
+            resume: bool = False, plan: tuple | None = None) -> dict:
     import itertools
 
     import jax
@@ -59,17 +59,33 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
     mcfg = dataclasses.replace(
         mcfg, matmul_precision=precision,
         attention_impl="flash" if jax.default_backend() == "tpu" else "xla")
+    step_kw = {}
+    if plan is not None:
+        # replay a tuner plan exactly: the chosen candidate's model
+        # knobs (remat/matmul), step knobs (reshard/accum/state/offload/
+        # overlap) and batch scale override this leg's flags
+        from distributed_training_sandbox_tpu.tuner import (
+            plan_cfg_overrides, plan_step_kwargs)
+        doc, _plan_path = plan
+        mcfg = dataclasses.replace(mcfg, **plan_cfg_overrides(doc))
+        precision = mcfg.matmul_precision
+        step_kw = plan_step_kwargs(doc)
+        bs *= int(doc["chosen"]["knobs"].get("batch_scale", 1))
+        print(f"[flagship] replaying plan {_plan_path}: "
+              f"{doc['chosen']['config']} (batch {bs})")
     mesh = make_mesh()
     ws = int(mesh.devices.size)
     key = set_seed(42)
     params = T.init_params(key, mcfg)
     shards = fsdp.shard_params_fsdp(params, mesh)
     del params
-    opt = fsdp.init_fsdp_opt_state(shards)
+    opt = (fsdp.init_fsdp_opt_state8(shards)
+           if step_kw.get("state_precision") == "int8"
+           else fsdp.init_fsdp_opt_state(shards))
     sched = (optim.warmup_cosine_schedule(peak_lr, warmup_steps, num_steps)
              if warmup_steps else None)
     step = fsdp.make_fsdp_train_step(shards, mcfg, mesh, lr=peak_lr,
-                                     lr_schedule=sched)
+                                     lr_schedule=sched, **step_kw)
 
     if data == "corpus":
         # the committed real-text corpus (reference trains its flagship
@@ -175,6 +191,8 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
 
     warm = f"warm{warmup_steps}" if warmup_steps else "nowarm"
     corp = "_corpus" if data == "corpus" else ""
+    if plan is not None and not tag_suffix:
+        tag_suffix = "_plan"
     tag = f"{model}_{precision}_seq{seq}_b{bs}_{warm}{corp}{tag_suffix}"
     result = {
         "model": model, "precision": precision, "sequence_length": seq,
@@ -186,6 +204,10 @@ def run_leg(model: str, precision: str, seq: int, bs: int, num_steps: int,
         "loss_final_mean20": float(np.mean(losses[-20:])),
         "losses": losses, "lrs": lrs,
     }
+    if plan is not None:
+        from distributed_training_sandbox_tpu.tuner import (
+            plan_manifest_stamp)
+        result["tuner"] = plan_manifest_stamp(plan[0], plan[1])
     out_dir.mkdir(parents=True, exist_ok=True)
     (out_dir / f"{tag}.json").write_text(json.dumps(result))
     print(f"[flagship] {tag}: first {losses[0]:.3f} "
@@ -250,11 +272,20 @@ def main(argv=None):
     p.add_argument("--cpu-devices", type=int, default=0)
     p.add_argument("--out-dir", default="flagship_results")
     p.add_argument("--plot", default="plots/flagship_loss.png")
+    p.add_argument("--plan", default=None, metavar="PLAN_JSON",
+                   help="replay a tuner plan (scripts/tune.py): the "
+                        "chosen knobs override --precision/--batch-size "
+                        "and the step-factory defaults")
     args = p.parse_args(argv)
 
     if args.cpu_devices:
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(args.cpu_devices)
+
+    plan = None
+    if args.plan:
+        from distributed_training_sandbox_tpu.tuner import load_plan
+        plan = (load_plan(args.plan), args.plan)
 
     out_dir = Path(args.out_dir)
     if args.spike_demo:
@@ -265,7 +296,7 @@ def main(argv=None):
             args.batch_size, args.num_steps, args.warmup_steps,
             args.peak_lr, out_dir, data=args.data,
             ckpt_dir=args.ckpt_dir, ckpt_every=args.checkpoint_every,
-            resume=args.resume)
+            resume=args.resume, plan=plan)
     plot(out_dir, Path(args.plot))
 
 

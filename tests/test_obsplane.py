@@ -465,6 +465,49 @@ def test_cost_model_export_roundtrip(tmp_path, capsys):
     assert "needs >= 3 runs" in capsys.readouterr().err
 
 
+def test_cost_model_schema_version_drift_fails_loudly(tmp_path):
+    """The export carries a pinned ``schema_version`` and every loader
+    path — the registry's own CostModel AND the tuner's assembled cost
+    model — refuses a drifted doc instead of silently mis-ranking."""
+    R = _runs_mod()
+    root = tmp_path / "runs"
+    for name, ms, bw, us in (("run1", 100.0, 10.0, 5000.0),
+                             ("run2", 90.0, 12.0, 4000.0),
+                             ("run3", 110.0, 11.0, 6000.0)):
+        _fake_indexed_run(root, name, ms, bw, us)
+    db = str(tmp_path / "runs.sqlite")
+    R.main(["--db", db, "index", "--results-dir", str(root)])
+    out_path = str(tmp_path / "cost_model.json")
+    assert R.main(["--db", db, "export-cost-model",
+                   "--out", out_path]) == 0
+    doc = json.loads(Path(out_path).read_text())
+    assert doc["schema_version"] == R.COST_MODEL_SCHEMA
+
+    # bumped version -> ValueError at construction, naming the re-export
+    for bad in ({**doc, "schema_version": doc["schema_version"] + 1,
+                 "schema": doc["schema_version"] + 1},
+                {k: v for k, v in doc.items()
+                 if k not in ("schema_version", "schema")}):
+        with pytest.raises(ValueError, match="schema_version"):
+            R.CostModel(bad)
+
+    # the tuner's loader goes through the same gate: a drifted file on
+    # disk raises out of from_artifacts rather than degrading silently
+    from distributed_training_sandbox_tpu.tuner import TunerCostModel
+    bad_path = tmp_path / "cost_model_drifted.json"
+    bad_path.write_text(json.dumps(
+        {**doc, "schema_version": 99, "schema": 99}))
+    with pytest.raises(ValueError, match="schema_version"):
+        TunerCostModel.from_artifacts(cost_model_path=str(bad_path),
+                                      prior_paths=[])
+    # the good file loads and prices through the tuner surface
+    tcm = TunerCostModel.from_artifacts(cost_model_path=out_path,
+                                        prior_paths=[])
+    assert tcm.cost_model is not None
+    assert tcm.cost_model.busbw_gbps(
+        "all_reduce", "≤2MiB", "data") is not None
+
+
 # ---- satellite: span-name cardinality lint ------------------------------
 
 def test_span_name_not_static_lint_red_green():

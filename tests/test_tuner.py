@@ -1,0 +1,215 @@
+"""Closed-loop autotuner suite: knob-space determinism, analytic
+pruning vs the recorded BENCH_r05 OOM wall, cost-model champion
+rediscovery on the checked-in priors, bitwise ``--plan`` replay through
+the zero driver, and the bench matrix's ``autotuned`` row.
+
+Everything runs on the 8-device simulated CPU mesh; the only compiles
+are the two tiny zero-driver replays in the bitwise test."""
+
+import glob
+
+import pytest
+
+from distributed_training_sandbox_tpu.models import transformer as T
+from distributed_training_sandbox_tpu.tuner import (
+    KnobSpace, TunerCandidate, TunerCostModel, check_plan, load_plan,
+    save_plan, tune)
+from distributed_training_sandbox_tpu.tuner.search import prune_candidates
+
+from conftest import REPO
+
+pytestmark = pytest.mark.tuner
+
+PRIORS = sorted(glob.glob(str(REPO / "BENCH_*.json")))
+
+# the v5e single-chip HBM capacity every BENCH round ran against
+CAPACITY_GB = 15.75
+
+
+# ------------------------------------------------------------ stage 1
+
+def test_knob_space_enumeration_deterministic():
+    """Two independently constructed spaces enumerate identically, hash
+    identically, and sample identically under the same seed — the
+    provenance stamp a plan.json carries is reproducible."""
+    s1, s2 = KnobSpace(), KnobSpace()
+    assert s1.space_hash() == s2.space_hash()
+    assert s1.enumerate(2) == s2.enumerate(2)
+    assert (s1.sample(20, seed=7, per_device_batch=2)
+            == s2.sample(20, seed=7, per_device_batch=2))
+    assert (s1.sample(20, seed=8, per_device_batch=2)
+            != s1.sample(20, seed=7, per_device_batch=2))
+    # axes -> from_axes round-trip preserves identity
+    assert KnobSpace.from_axes(s1.axes()).space_hash() == s1.space_hash()
+
+
+def test_knob_space_respects_feasibility_rules():
+    """Enumeration applies the step factories' own rules: accumulation
+    divides the per-device batch, activation offload only with a
+    named-save remat policy."""
+    for pdb in (1, 2):
+        for c in KnobSpace().enumerate(pdb):
+            assert (pdb * c.batch_scale) % c.accum_steps == 0
+            if c.offload == "opt_act":
+                assert c.remat_policy in ("save_attn", "save_dots_q8")
+
+
+# ------------------------------------------------------------ stage 2
+
+# the BENCH_r05 OOM wall: (remat, matmul, state, global batch at ws=1,
+# compiler-reported needed GB) — every row actually OOMed a 15.75 GB chip
+OOM_WALL = [
+    ("save_dots_q8", "int8_bwd", "full", 4, 18.41),
+    ("full", "int8_bwd", "int8", 16, 19.86),
+    ("save_dots", "int8_bwd", "int8", 2, 18.20),
+    ("save_dots_q8", "int8_bwd", "int8", 4, 16.82),
+]
+
+
+def test_prune_agrees_with_recorded_oom_verdicts():
+    """Stage-2 analytic pruning rejects every candidate the BENCH_r05
+    round actually OOMed on, pre-compile, and reports each rejection
+    with its predicted GB."""
+    cfg = T.SMOLLM3_3B_L8
+    cands = [TunerCandidate(batch_scale=b, remat_policy=r,
+                            matmul_precision=q, state_precision=s)
+             for r, q, s, b, _ in OOM_WALL]
+    survivors, pruned, _ = prune_candidates(
+        cands, cfg, base_batch=1, seq=8192, ws=1,
+        capacity_gb=CAPACITY_GB)
+    assert survivors == [], \
+        f"recorded OOMs survived: {[c.bench_name() for c in survivors]}"
+    assert len(pruned) == len(OOM_WALL)
+    for row in pruned:
+        assert row["predicted_gb"] > CAPACITY_GB
+        assert row["capacity_gb"] == CAPACITY_GB
+
+
+def test_prune_without_capacity_keeps_everything():
+    """No capacity (CPU sim, no --budget-gb): nothing prunes, but the
+    per-candidate predictions still ride along for the plan record."""
+    cands = KnobSpace().enumerate(2)[:8]
+    survivors, pruned, preds = prune_candidates(
+        cands, T.TINY_LM, base_batch=2, seq=32, ws=8, capacity_gb=None)
+    assert survivors == cands and pruned == []
+    assert all(preds[c] > 0 for c in cands)
+
+
+# ------------------------------------------------------------ stage 3
+
+def test_champion_rediscovered_in_top5_on_checked_in_priors():
+    """The acceptance rediscovery: enumerate the full space at the
+    flagship's operating point, prune against the real chip capacity,
+    rank on the checked-in BENCH priors — the hand-found champion
+    (explicit_int8_bwd_s8_b4x, BENCH_r05) must sit in the predicted
+    top-5, i.e. the tuner would have measured it."""
+    cfg = T.SMOLLM3_3B_L8
+    cost = TunerCostModel.from_artifacts(prior_paths=PRIORS)
+    cands = KnobSpace().enumerate(2)
+    survivors, pruned, _ = prune_candidates(
+        cands, cfg, base_batch=2, seq=8192, ws=1,
+        capacity_gb=CAPACITY_GB)
+    assert pruned, "the OOM wall should prune part of the space"
+    ranked = cost.rank(survivors, cfg, seq=8192, base_batch=2, ws=1)
+    top5 = [pred["config"] for _, pred in ranked[:5]]
+    assert "explicit_int8_bwd_s8_b4x" in top5, top5
+
+
+def test_cost_model_hash_tracks_priors():
+    """Two cost models over the same priors hash identically; different
+    priors hash differently — the plan's provenance stamp is real."""
+    a = TunerCostModel.from_artifacts(prior_paths=PRIORS)
+    b = TunerCostModel.from_artifacts(prior_paths=PRIORS)
+    assert a.hash() == b.hash()
+    c = TunerCostModel.from_artifacts(prior_paths=PRIORS[:1])
+    assert c.hash() != a.hash()
+
+
+# ------------------------------------------------------- plan + replay
+
+def test_plan_replay_is_bitwise_deterministic(tmp_path):
+    """A plan chosen by the tuner replays exactly: two zero-driver runs
+    under the same ``--plan`` produce bit-identical loss sequences on
+    both the baseline and sharded legs."""
+    space = KnobSpace(batch_scale=(2,), accum_steps=(1,),
+                      remat_policy=("full",), matmul_precision=("bf16",),
+                      state_precision=("full",), offload=("none",))
+    doc = tune("TINY_LM", 32, 2, space=space, top_k=0)
+    path = tmp_path / "plan.json"
+    save_plan(doc, str(path))
+    loaded = load_plan(str(path))
+    assert loaded["chosen"]["knobs"]["batch_scale"] == 2
+
+    from scripts._zero_driver import run_zero_ab
+    args = ["--scale", "100", "--num-steps", "4", "--no-profile",
+            "--plan", str(path)]
+    r1 = run_zero_ab(1, args)
+    r2 = run_zero_ab(1, args)
+    assert r1["base_losses"] == r2["base_losses"]
+    assert r1["shard_losses"] == r2["shard_losses"]
+
+
+def test_check_plan_flags_drift():
+    """The staleness gate: a plan whose recorded hashes match the
+    current code is fresh; a drifted knob-space or cost-model hash is
+    reported with a reason naming what moved."""
+    space = KnobSpace()
+    cost = TunerCostModel(priors=[])
+    doc = {"objective": "throughput",
+           "knob_space_hash": space.space_hash(),
+           "cost_model_hash": cost.hash()}
+    fresh = check_plan(doc, space=space, cost=cost)
+    assert not fresh["stale"] and fresh["reasons"] == []
+    drifted = check_plan({**doc, "knob_space_hash": "deadbeef"},
+                         space=space, cost=cost)
+    assert drifted["stale"]
+    assert any("knob space" in r for r in drifted["reasons"])
+
+
+def test_load_plan_rejects_wrong_schema(tmp_path):
+    import json
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"schema_version": 99,
+                             "chosen": {"knobs": {}}}))
+    with pytest.raises(ValueError, match="schema_version"):
+        load_plan(str(p))
+
+
+# ------------------------------------------------------ bench closure
+
+def test_autotuned_row_ties_best_covered_hand_row():
+    """The matrix's ``autotuned`` row reuses the run's own measured
+    numbers, so it ties the best hand-written explicit row by
+    construction — and records whether the pre-measurement ranking
+    already had the winner on top."""
+    import bench
+    rows = [
+        {"config": "explicit", "tokens_per_sec": 1000.0,
+         "tflops_per_device": 1.0, "step_ms": 10.0},
+        {"config": "explicit_int8_bwd", "tokens_per_sec": 1180.0,
+         "tflops_per_device": 1.18, "step_ms": 9.0},
+        {"config": "explicit_save_dots", "tokens_per_sec": 900.0,
+         "tflops_per_device": 0.9, "step_ms": 11.0},
+        # outside the explicit grammar — not a tuner-coverable row
+        {"config": "ring", "tokens_per_sec": 2000.0},
+        # errored rows never win
+        {"config": "explicit_b2x", "error": "boom"},
+    ]
+    auto = bench._autotuned_row("TINY_LM", 32, 8, rows)
+    assert auto["config"] == "autotuned"
+    assert auto["chosen_from"] == "explicit_int8_bwd"
+    covered = set(auto["tuner"]["covered"])
+    assert covered == {"explicit", "explicit_int8_bwd",
+                       "explicit_save_dots"}
+    best = max(r["tokens_per_sec"] for r in rows
+               if r["config"] in covered)
+    assert auto["tokens_per_sec"] >= best
+    assert isinstance(auto["tuner"]["predicted_hit"], bool)
+    assert auto["tuner"]["knob_space_hash"] == KnobSpace().space_hash()
+
+
+def test_autotuned_row_none_when_nothing_covered():
+    import bench
+    assert bench._autotuned_row(
+        "TINY_LM", 32, 8, [{"config": "ring", "tokens_per_sec": 1.0}]) \
+        is None
