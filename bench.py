@@ -168,6 +168,27 @@ KNOB_MATRIX = [
     ("explicit_save_dots_q8_int8_s8_b4x", {"remat_policy": "save_dots_q8",
                                            "matmul_precision": "int8_bwd"},
      {"reshard_after_forward": True, "state_precision": "int8"}, 4),
+    # r6: the fp8 tier (ops/quant: e4m3 fwd / e5m2 bwd, per-tensor
+    # scaling) and the Pallas fused collective matmul.  fp8 rows are the
+    # real-recipe twins of int8_bwd — same 1-byte wire codes, but the
+    # float format the reference trained with; "fp8_delayed" swaps
+    # dynamic absmax for the amax-history schedule (one fewer reduction
+    # on the hot path), and the b4x crossing challenges the s8_b4x
+    # ceiling at the batch where it was set.  Off-TPU these measure the
+    # emulated upcast dot — recipe overhead, not fp8-unit speedups.
+    ("explicit_fp8", {"matmul_precision": "fp8"},
+     {"reshard_after_forward": True}, 1),
+    ("explicit_fp8_delayed", {"matmul_precision": "fp8_delayed"},
+     {"reshard_after_forward": True}, 1),
+    ("explicit_fp8_b4x", {"matmul_precision": "fp8"},
+     {"reshard_after_forward": True}, 4),
+    ("explicit_fp8_s8_b4x", {"matmul_precision": "fp8"},
+     {"reshard_after_forward": True, "state_precision": "int8"}, 4),
+    # overlap A/B third twin: the ring decomposition with the per-hop
+    # partial matmul issued from inside the Pallas kernel (falls back to
+    # interpret mode off-TPU; bitwise vs explicit_ring_fused either way)
+    ("explicit_ring_fused_pallas", {}, {"reshard_after_forward": True,
+                                        "overlap": "ring_fused_pallas"}, 1),
 ]
 
 

@@ -150,6 +150,37 @@ def _fsdp_ring_counts(c: ContractContext) -> dict:
             "collective_permute": (hops, 2 * hops)}
 
 
+# dense transformer projection leaves per layer (wq wk wv wo w_gate
+# w_up w_down) — the leaves the ring_fused modes keep sharded and run
+# as collective matmuls.  Constant for the dense family; MoE is
+# rejected by the fused modes' validation.
+N_PROJ_LEAVES = 7
+
+
+def _fsdp_ring_fused_pallas_counts(c: ContractContext) -> dict:
+    """fsdp with the projection matmuls fused into the gather ring
+    (Pallas chunk-matmul engine): the 7 projection leaves never
+    materialize — each runs ws-1 ppermute hops forward (all_gather_matmul)
+    and ws-1 backward (the dW ring of matmul_reduce_scatter's transpose);
+    the remaining leaves (norms, embed, final_norm) keep the plain ring
+    gather with its monolithic psum_scatter backward.  Remat re-runs
+    forward rings in the backward scan, hence the 2x upper bound."""
+    ws = c.axis_sizes.get("dp", c.ws)
+    unfused = c.n_leaves - N_PROJ_LEAVES
+    hops = (unfused + 2 * N_PROJ_LEAVES) * (ws - 1)
+    return {"all_reduce": 1, "reduce_scatter": unfused,
+            "collective_permute": (hops, 2 * hops)}
+
+
+def _tp_q8_counts(c: ContractContext) -> dict:
+    """tp with the two per-layer rejoin psums running as EQuARX two-shot
+    quantized all-reduces: each rejoin site becomes 2 all_gather sites
+    (int8 codes + f32 scales over the same tp group) and leaves the
+    all_reduce budget to the rejoins' full-precision backward psums,
+    per-leaf grad psums and the loss mean."""
+    return {"all_reduce": (c.n_leaves, c.n_leaves + 6), "all_gather": 4}
+
+
 def _tp_ring_counts(c: ContractContext) -> dict:
     """tp with the two per-layer rejoin psums decomposed into
     psum_scatter + ring all-gather: 2 reduce_scatter sites, tp-1 hops
@@ -274,6 +305,32 @@ CONTRACTS: dict[str, CollectiveContract] = {
         host_transfers=_offload_host_transfers,
         description="fsdp choreography + declared MoveToHost/MoveToDevice "
                     "streaming of host-resident optimizer state"),
+    # fsdp with matmul_precision=fp8: the e4m3/e5m2 scaled matmuls live
+    # entirely inside the dense seam — the WIRE choreography is exactly
+    # fsdp's (the precision leg changes flops and working set, not
+    # collectives), which is precisely what this contract pins down
+    "fsdp_fp8": CollectiveContract(
+        "fsdp_fp8", ("dp",),
+        lambda c: {"all_reduce": 1,
+                   "all_gather": c.n_leaves,
+                   "reduce_scatter": c.n_leaves},
+        allows_full_param_gather=True,
+        payload_bytes=lambda c: 3 * c.param_bytes,
+        description="fsdp choreography unchanged: fp8 scaling is local "
+                    "to the dense seam, any site delta is a leak"),
+    # fsdp with --overlap ring_fused_pallas: projection leaves fused
+    # into collective matmuls with the Pallas chunk-matmul engine — the
+    # ppermute hops stay at the XLA level (CPU interpret has no remote
+    # DMA), so the wire counts match the fused choreography, not the
+    # kernel impl
+    "fsdp_ring_fused_pallas": CollectiveContract(
+        "fsdp_ring_fused_pallas", ("dp",),
+        _fsdp_ring_fused_pallas_counts,
+        allows_full_param_gather=True,
+        payload_bytes=lambda c: 3 * c.param_bytes,
+        description="7 projection leaves as fused ring matmuls (fwd + "
+                    "bwd hop rings, no gather/scatter sites), plain "
+                    "ring + psum_scatter for the rest, one loss pmean"),
     # fsdp with --overlap ring: the overlap engine's decomposed gathers
     # (ops.collectives.ring_all_gather) — ppermute hops instead of
     # monolithic all_gathers, bitwise-identical losses
@@ -293,6 +350,18 @@ CONTRACTS: dict[str, CollectiveContract] = {
         description="2 rejoin psum_scatter sites + 2(tp-1) ppermute hops "
                     "+ per-leaf grad psums; gather/scatter of params "
                     "still forbidden"),
+    # tp with --overlap q8: rejoin psums ride the wire as int8 codes +
+    # scales (EQuARX two-shot, arXiv:2506.17615) — all_gather sites over
+    # tp replace the 2 rejoin all_reduce sites; grads stay full-precision
+    "tp_q8": CollectiveContract(
+        "tp_q8", ("dp", "tp"), _tp_q8_counts,
+        # two rejoins/layer-site ship int8 + f32-scale instead of f32:
+        # ~4x fewer activation bus bytes (informational; activation
+        # payloads aren't param-tree-derivable, so no estimate)
+        payload_bytes=None,
+        description="4 all_gather sites (codes + scales per rejoin) + "
+                    "full-precision grad/backward psums; gather of "
+                    "params still forbidden"),
     # Megatron TP: activations psum'd in the layer body (2/layer-site),
     # grads psum'd per replicated leaf; NO param gathers or scatters —
     # an all_gather here means a param silently went dp-replicated.
@@ -337,6 +406,15 @@ CONTRACTS: dict[str, CollectiveContract] = {
         payload_bytes=None,
         description="2 activation psums per (unrolled) layer over tp "
                     "only; no grads, so no other collective may appear"),
+    # serve_decode with the Pallas paged-attention kernel: attention
+    # reads KV pages in place inside the kernel — pure local compute,
+    # so the wire choreography is bitwise serve_decode's
+    "serve_decode_paged_kernel": CollectiveContract(
+        "serve_decode_paged_kernel", ("tp",),
+        lambda c: {"all_reduce": 2 * c.n_layers},
+        payload_bytes=None,
+        description="2 activation psums per (unrolled) layer over tp "
+                    "only; the paged kernel adds zero wire sites"),
     # pipeline stages are single-device jitted programs; inter-stage comm
     # is host-mediated device transfer, never a mesh collective
     "gpipe": CollectiveContract(

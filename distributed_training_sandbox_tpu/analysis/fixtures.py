@@ -151,8 +151,9 @@ def _build_mlp_dp(strategy: str, *, mesh=None, scale: int = 100,
                          ctx, donate=True, full_param_shapes=shapes)
 
 
-@register_strategy("fsdp", "fsdp_ring", "fsdp_offload", "tp", "tp_ring",
-                   "sp", "moe")
+@register_strategy("fsdp", "fsdp_ring", "fsdp_fp8",
+                   "fsdp_ring_fused_pallas", "fsdp_offload", "tp",
+                   "tp_ring", "tp_q8", "sp", "moe")
 def _build_transformer(strategy: str, *, mesh=None, scale: int = 100,
                        seq: int = 32,
                        batch_size: int = 8) -> StrategyBuild:
@@ -168,9 +169,11 @@ def _build_transformer(strategy: str, *, mesh=None, scale: int = 100,
     key = set_seed(0)
     n_dev = len(jax.devices())
     mcfg = T.TINY_LM
-    second_axis = {"fsdp": None, "fsdp_ring": None,
+    second_axis = {"fsdp": None, "fsdp_ring": None, "fsdp_fp8": None,
+                   "fsdp_ring_fused_pallas": None,
                    "fsdp_offload": None, "tp": "tp",
-                   "tp_ring": "tp", "sp": "sp", "moe": "ep"}[strategy]
+                   "tp_ring": "tp", "tp_q8": "tp", "sp": "sp",
+                   "moe": "ep"}[strategy]
     if mesh is None:
         if second_axis is None:
             mesh = make_mesh(register=False)
@@ -188,11 +191,18 @@ def _build_transformer(strategy: str, *, mesh=None, scale: int = 100,
     shapes = param_shapes(params, min_numel=1024)
     ctx = ContractContext.capture(params=params, mesh=mesh,
                                   n_layers=mcfg.num_hidden_layers)
-    if strategy in ("fsdp", "fsdp_ring"):
+    if strategy in ("fsdp", "fsdp_ring", "fsdp_fp8",
+                    "fsdp_ring_fused_pallas"):
+        if strategy == "fsdp_fp8":
+            # the fp8 precision leg: e4m3 fwd / e5m2 bwd scaled matmuls
+            # in the dense seam — same gather choreography as fsdp
+            mcfg = _dc.replace(mcfg, matmul_precision="fp8")
+        overlap = {"fsdp_ring": "ring",
+                   "fsdp_ring_fused_pallas": "ring_fused_pallas"}.get(
+                       strategy, "none")
         shards = fsdp.shard_params_fsdp(params, mesh)
-        step = fsdp.make_fsdp_train_step(
-            shards, mcfg, mesh,
-            overlap="ring" if strategy == "fsdp_ring" else "none")
+        step = fsdp.make_fsdp_train_step(shards, mcfg, mesh,
+                                         overlap=overlap)
     elif strategy == "fsdp_offload":
         # host-offloaded optimizer state: park the Adam moments in
         # pinned host memory (identity placement on the CPU sim) and
@@ -216,11 +226,12 @@ def _build_transformer(strategy: str, *, mesh=None, scale: int = 100,
     elif strategy == "sp":
         shards = fsdp.shard_params_fsdp(params, mesh, "dp")
         step = sequence.make_sp_train_step(shards, mcfg, mesh)
-    elif strategy in ("tp", "tp_ring"):
+    elif strategy in ("tp", "tp_ring", "tp_q8"):
         shards = tensor.shard_params_tp(params, mesh)
         step = tensor.make_tp_train_step(
             shards, mcfg, mesh,
-            overlap="ring" if strategy == "tp_ring" else "none")
+            overlap={"tp_ring": "ring", "tp_q8": "q8"}.get(
+                strategy, "none"))
     else:
         shards = expert.shard_moe_lm_params(params, mesh)
         step = expert.make_moe_lm_train_step(shards, mcfg, mesh)
@@ -231,11 +242,12 @@ def _build_transformer(strategy: str, *, mesh=None, scale: int = 100,
                          full_param_shapes=shapes)
 
 
-@register_strategy("serve_decode")
+@register_strategy("serve_decode", "serve_decode_paged_kernel")
 def _build_serve_decode(strategy: str, *, mesh=None, scale: int = 100,
                         seq: int = 32,
                         batch_size: int = 8) -> StrategyBuild:
-    """Serving decode step over dp × tp."""
+    """Serving decode step over dp × tp (``_paged_kernel``: attention
+    through the Pallas paged decode kernel, bitwise choreography twin)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -265,8 +277,9 @@ def _build_serve_decode(strategy: str, *, mesh=None, scale: int = 100,
     pool = PagedKVPool(_decode_cfg(mcfg),
                        batch_size * pages_per + 1, page_size,
                        mesh=mesh)
-    step = make_serve_decode_step(mcfg, shards, mesh=mesh,
-                                  pool_spec=pool.spec)
+    step = make_serve_decode_step(
+        mcfg, shards, mesh=mesh, pool_spec=pool.spec,
+        paged_kernel=strategy == "serve_decode_paged_kernel")
     pages = jnp.asarray(np.arange(
         1, batch_size * pages_per + 1,
         dtype=np.int32).reshape(batch_size, pages_per))

@@ -55,6 +55,15 @@ traces it), tuned so the current ``scripts/`` tree is clean at the
     choice.  A deliberate monolithic gather (e.g. the baseline leg of
     an A/B) marks the line — or the line above — with ``# gather-ok``.
 
+  * ``pallas-call-no-interpret`` (error) — a ``pl.pallas_call(...)``
+    site in library code with no ``interpret=`` argument: the kernel
+    would compile for whatever backend is active, so the CPU tier
+    (every test and fixture here) crashes instead of interpreting.
+    Every kernel wrapper must plumb an ``interpret`` knob (the repo
+    convention: default ``jax.default_backend() != "tpu"``).  A site
+    that forwards ``**kwargs`` is accepted; a deliberate compile-only
+    call marks the line — or the line above — with ``# pallas-ok``.
+
   * ``span-name-not-static`` (error) — a span/metric emit site
     (``maybe_span`` / ``spans.span`` / ``spans.record`` /
     ``metrics.inc|set|observe`` and their ``maybe_*`` guards) whose
@@ -166,6 +175,7 @@ class _Visitor(ast.NodeVisitor):
         self.gathers_in_step: list[tuple[int, str]] = []
         self.swallowed: list[tuple[int, str]] = []
         self.dynamic_emit_names: list[tuple[int, str]] = []
+        self.pallas_no_interpret: list[tuple[int, str]] = []
 
     # -- context tracking -------------------------------------------------
     def _visit_function(self, node):
@@ -245,6 +255,13 @@ class _Visitor(ast.NodeVisitor):
                 self.gathers_in_step.append((node.lineno, chain))
         if leaf in RING_VARIANTS:
             self.has_ring_variant = True
+        if leaf == "pallas_call":
+            # interpret= may arrive positionally never (keyword-only in
+            # pallas), via an explicit keyword, or through **kwargs
+            kw = {k.arg for k in node.keywords}
+            if "interpret" not in kw and None not in kw:
+                self.pallas_no_interpret.append((node.lineno,
+                                                 chain or leaf))
         if leaf in CKPT_OPENERS:
             self.ckpt_opens.append((node.lineno, chain))
         if leaf in CKPT_GUARDS:
@@ -415,6 +432,16 @@ def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
                 f"(overlap='ring') so its hops can hide behind compute, "
                 f"or mark a deliberate monolithic gather with "
                 f"'# gather-ok'"))
+    for line, chain in v.pallas_no_interpret:
+        if _pragma(line, "pallas-ok"):
+            continue
+        findings.append(PitfallFinding(
+            path, line, "pallas-call-no-interpret", SEV_ERROR,
+            f"{chain}() without an interpret= argument — the kernel "
+            f"hard-compiles for the active backend and the CPU tier "
+            f"cannot run it; plumb an interpret knob through the "
+            f"wrapper (default jax.default_backend() != 'tpu'), or "
+            f"mark a deliberate compile-only site with '# pallas-ok'"))
     for line, chain in v.dynamic_emit_names:
         if _pragma(line, "span-ok"):
             continue

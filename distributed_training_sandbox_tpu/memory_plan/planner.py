@@ -29,7 +29,7 @@ from dataclasses import dataclass, field, replace as _dc_replace
 from .predictor import WaterlinePrediction, analytic_waterline
 
 REMAT_POLICIES = ("full", "save_attn", "save_dots", "save_dots_q8")
-QUANT_CHOICES = ("bf16", "int8_bwd")
+QUANT_CHOICES = ("bf16", "int8_bwd", "fp8")
 STATE_CHOICES = ("full", "int8")
 OFFLOAD_CHOICES = ("none", "opt")
 
@@ -39,7 +39,15 @@ OFFLOAD_CHOICES = ("none", "opt")
 # q8-saved dots give ~most of save_dots' win back to the round-trip.
 _REMAT_SPEED = {"full": 1.00, "save_attn": 1.03, "save_dots": 1.06,
                 "save_dots_q8": 1.045}
-_QUANT_SPEED = {"bf16": 1.00, "int8_bwd": 1.18}
+# fp8 multipliers are CPU-tier placeholders pending a TPU-measured row
+# (no fp8 units on v5e — see ops/quant.py), so they sit strictly BELOW
+# the measured int8_bwd anchor: a config no bench row has ever timed
+# must not outrank one a row has — the same measured-beats-multiplier
+# pessimism the tuner cost model applies.  Internal ordering kept:
+# delayed scaling saves the per-step amax reduction over dynamic, the
+# hand Pallas kernel trails XLA (matching the measured int8 kernel gap).
+_QUANT_SPEED = {"bf16": 1.00, "int8_bwd": 1.18, "fp8": 1.10,
+                "fp8_delayed": 1.11, "fp8_pallas": 1.05}
 _STATE_SPEED = {"full": 1.00, "int8": 1.00}
 # host offload pays PCIe streaming; activation offload pays it per layer
 _OFFLOAD_SPEED = {"none": 1.00, "opt": 0.97, "opt_act": 0.90}
@@ -177,9 +185,10 @@ def modeled_speed(c: Candidate, prior: dict | None = None) -> float:
 
 # ---------------------------------------------------------- bench priors
 
-# bench.py row names: explicit[_reshard|_noreshard][_save_*][_int8(_bwd)]
-# [_s8][_b{N}x] — parsed back into candidate knobs so measured rows can
-# anchor the planner's throughput model.
+# bench.py row names: explicit[_reshard|_noreshard][_save_*]
+# [_int8(_bwd)|_fp8(_delayed|_pallas)][_s8][_b{N}x] — parsed back into
+# candidate knobs so measured rows can anchor the planner's throughput
+# model.
 _NAME_BSCALE = re.compile(r"_b(\d+)x$")
 
 
@@ -203,6 +212,13 @@ def parse_bench_config_name(name: str) -> dict | None:
     if "_int8" in rest:
         knobs["matmul_precision"] = "int8_bwd"
         rest = rest.replace("_int8_bwd", "").replace("_int8", "")
+    elif "_fp8" in rest:
+        # longest token first so "fp8" never eats its variants' suffixes
+        for tok in ("fp8_delayed", "fp8_pallas", "fp8"):
+            if f"_{tok}" in rest:
+                knobs["matmul_precision"] = tok
+                rest = rest.replace(f"_{tok}", "")
+                break
     rest = rest.strip("_")
     if rest:
         if rest not in REMAT_POLICIES:

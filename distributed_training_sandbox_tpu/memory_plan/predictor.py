@@ -162,17 +162,19 @@ def analytic_waterline(cfg, *, batch: int, seq: int, ws: int = 1,
         saved = L * micro * seq * dot_bytes / itemsize * 1.1
     if offload == "opt_act" and policy in ("save_attn", "save_dots_q8"):
         saved = 0.0                                    # parked on host
-    int8_mm = str(getattr(cfg, "matmul_precision", "bf16")).startswith(
-        "int8")
-    # int8 backward matmuls keep quantized operand copies for the bwd
-    # dots — they ride the saved-dots budget when remat keeps those
-    # (save_dots_q8's saved tensors already ARE the int8 codes: no extra)
-    if int8_mm and policy == "save_dots":
+    precision = str(getattr(cfg, "matmul_precision", "bf16"))
+    # low-precision matmuls (int8 STE or fp8 e4m3/e5m2) keep 1-byte
+    # operand code copies for the bwd dots — same working-set shape, so
+    # both precisions share the multiplier; they ride the saved-dots
+    # budget when remat keeps those (save_dots_q8's saved tensors
+    # already ARE the int8 codes: no extra)
+    lp_mm = precision.startswith("int8") or precision.startswith("fp8")
+    if lp_mm and policy == "save_dots":
         saved *= 1.5
 
     # one layer's transient working set (freed before the loss phase);
-    # int8 matmuls add the live microbatch's quantize buffers
-    working = micro * seq * dot_bytes * (1.5 if int8_mm else 1.0)
+    # low-precision matmuls add the live microbatch's quantize buffers
+    working = micro * seq * dot_bytes * (1.5 if lp_mm else 1.0)
     if getattr(cfg, "attention_impl", "xla") == "xla":
         # unfused attention materializes fp32 scores (B, n, S, S)
         working += micro * nq * seq * seq * 4

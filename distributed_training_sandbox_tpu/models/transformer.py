@@ -103,8 +103,17 @@ class TransformerConfig:
     # scaling (forward quantized, backward bf16) — the reference's fp8
     # benchmark knob (fp8_benchmark.py:47) with v5e's native low-precision
     # format.  "int8_pallas" routes through the hand-tiled Pallas kernel.
+    # The fp8 tier is the recipe-faithful Float8Linear twin (e4m3 fwd /
+    # e5m2 bwd per-tensor scales, ops/quant.fp8_dense): "fp8" (dynamic
+    # scaling), "fp8_delayed" (amax-history delayed scaling, depth
+    # ``fp8_amax_history_len``), "fp8_pallas" (Pallas forward kernel).
     # "bf16" | "int8" | "int8_pallas" | "int8_bwd" | "int8_pallas_bwd"
+    #        | "fp8" | "fp8_delayed" | "fp8_pallas"
     matmul_precision: str = "bf16"
+    # Delayed-scaling amax history depth for "fp8_delayed" (torchao's
+    # ``delayed`` recipe rolls this many step amaxes; ignored by the
+    # dynamic fp8 variants).
+    fp8_amax_history_len: int = 16
     gated_mlp: bool = True  # duck-types as FlopsConfig for utils.flops
     # Mixture-of-experts MLP (parallel/expert.py): 0 = dense.  With
     # n_experts > 0 every layer's MLP becomes a top-1 switch-MoE of
@@ -405,7 +414,9 @@ def _attention_flash(q, k, v, scale: float) -> jax.Array:
 def _dense(cfg: TransformerConfig):
     """The projection matmul at the configured precision.  Precisions:
     bf16; int8 (XLA fwd); int8_pallas (fused quantize-matmul kernel fwd);
-    *_bwd variants additionally run both backward matmuls at int8.
+    *_bwd variants additionally run both backward matmuls at int8; the
+    fp8 family (fp8 / fp8_delayed / fp8_pallas) runs the Float8Linear
+    e4m3-forward/e5m2-backward recipe end to end.
 
     Under ``remat_policy="save_dots_q8"`` (and only with remat ON —
     without ``jax.checkpoint`` nothing is saved, so the round-trip
@@ -416,14 +427,19 @@ def _dense(cfg: TransformerConfig):
     A weight arriving as :class:`ops.collectives.RingShard` (the
     ``overlap="ring_fused"`` FSDP layer hook leaves projection weights
     sharded along their contraction dim) routes through the decomposed
-    collective matmul ``all_gather_matmul`` — gather hops interleaved
-    with the chunk matmuls instead of a monolithic gather-then-dot."""
+    collective matmul — ``all_gather_matmul``, or its Pallas tile-kernel
+    twin when the shard is marked ``impl="pallas"``
+    (``overlap="ring_fused_pallas"``) — gather hops interleaved with
+    the chunk matmuls instead of a monolithic gather-then-dot."""
     from ..ops import collectives as C
     from ..ops.quant import quantized_residual, resolve_quantized_dense
-    base = resolve_quantized_dense(cfg.matmul_precision)
+    base = resolve_quantized_dense(
+        cfg.matmul_precision, fp8_history_len=cfg.fp8_amax_history_len)
 
     def dispatch(a, w):
         if isinstance(w, C.RingShard):
+            if w.impl == "pallas":
+                return C.all_gather_matmul_pallas(a, w.shard, w.axis_name)
             return C.all_gather_matmul(a, w.shard, w.axis_name)
         return base(a, w)
 
@@ -515,9 +531,17 @@ def _layer_body(x, layer, *, cfg: TransformerConfig, cos, sin, use_rope,
     if tp_axis:  # Megatron f/g: rejoin the row-parallel partial sums
         from ..ops import collectives as C
         from ..utils.profiling import scope
-        _rejoin = ((lambda v: C.decomposed_all_reduce(v, tp_axis, axis=-1))
-                   if tp_overlap == "ring"
-                   else (lambda v: C.all_reduce(v, tp_axis)))
+        if tp_overlap == "ring":
+            _rejoin = lambda v: C.decomposed_all_reduce(v, tp_axis,
+                                                        axis=-1)
+        elif tp_overlap == "q8":
+            # EQuARX two-shot: partial sums ship as int8 codes + scales
+            # (~4x fewer bus bytes than the f32 psum), dequant-sum after
+            # the wire; backward stays a full-precision psum.
+            from ..ops.quant import quantized_all_reduce
+            _rejoin = lambda v: quantized_all_reduce(v, tp_axis)
+        else:
+            _rejoin = lambda v: C.all_reduce(v, tp_axis)
         with scope("tp_attn_psum"):
             attn_out = _rejoin(attn_out)
     x = x + attn_out

@@ -174,20 +174,27 @@ class RingShard:
     ``parallel.fsdp``'s ring_fused layer hook hands to the model so the
     projection matmul runs as ``all_gather_matmul`` instead of
     gather-then-matmul.  Registered as a pytree so it rides through scan
-    / remat / AD like the plain array it replaces."""
+    / remat / AD like the plain array it replaces.
 
-    def __init__(self, shard, axis_name: str):
+    ``impl`` selects the per-chunk matmul engine: ``"xla"`` (the plain
+    traced ``@``) or ``"pallas"`` (:func:`all_gather_matmul_pallas`'s
+    tile kernel) — aux data, so the two variants trace as distinct
+    programs."""
+
+    def __init__(self, shard, axis_name: str, impl: str = "xla"):
         self.shard = shard
         self.axis_name = axis_name
+        self.impl = impl
 
     def __repr__(self):  # pragma: no cover - debugging aid
-        return f"RingShard({self.shard.shape}, axis={self.axis_name!r})"
+        return (f"RingShard({self.shard.shape}, axis={self.axis_name!r}, "
+                f"impl={self.impl!r})")
 
 
 jax.tree_util.register_pytree_node(
     RingShard,
-    lambda rs: ((rs.shard,), rs.axis_name),
-    lambda axis_name, children: RingShard(children[0], axis_name))
+    lambda rs: ((rs.shard,), (rs.axis_name, rs.impl)),
+    lambda aux, children: RingShard(children[0], *aux))
 
 
 def _ring_perm(n: int, shift: int = 1):
@@ -318,6 +325,115 @@ def all_gather_matmul(a, w_shard, axis_name: str):
         a_chunk = lax.dynamic_slice_in_dim(a, src * k_chunk, k_chunk,
                                            axis=a.ndim - 1)
         acc = acc + a_chunk @ cur
+        if t < n - 1:
+            cur = lax.ppermute(cur, axis_name, _ring_perm(n))
+    return acc.astype(a.dtype)
+
+
+def _agmm_chunk_kernel(a_ref, w_ref, o_ref):
+    o_ref[...] = jnp.dot(a_ref[...], w_ref[...],
+                         preferred_element_type=o_ref.dtype)
+
+
+def _agmm_tile_call(a2, w, out_dtype, block_m, block_n, interpret):
+    """One ring chunk's matmul as a Pallas call: grid over (M/bm, N/bn)
+    row/col tiles, each block carrying full K (the chunk's contraction
+    dim) so every output element's K-sum happens in ONE dot — which is
+    what keeps the default full-block configuration bitwise against the
+    traced ``a_chunk @ cur``."""
+    from jax.experimental import pallas as pl
+
+    M, K = a2.shape
+    N = w.shape[1]
+    bm = block_m or M
+    bn = block_n or N
+    return pl.pallas_call(
+        _agmm_chunk_kernel,
+        grid=(M // bm, N // bn),
+        in_specs=[pl.BlockSpec((bm, K), lambda i, j: (i, 0)),
+                  pl.BlockSpec((K, bn), lambda i, j: (0, j))],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        interpret=interpret,
+    )(a2, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _pallas_chunk_matmul(a, w, block_m, block_n, interpret):
+    """``a @ w`` with the forward tile-matmul in Pallas and the backward
+    pinned to the XLA dot transposes the traced ``@`` would generate —
+    pallas_call has no AD rule, and pinning keeps the ring_fused_pallas
+    step's gradients on the same arithmetic as ring_fused's."""
+    out, _ = _pcm_fwd(a, w, block_m, block_n, interpret)
+    return out
+
+
+def _pcm_fwd(a, w, block_m, block_n, interpret):
+    lead = a.shape[:-1]
+    a2 = a.reshape(-1, a.shape[-1])
+    out_dtype = jnp.promote_types(a.dtype, w.dtype)
+    out = _agmm_tile_call(a2, w, out_dtype, block_m, block_n, interpret)
+    return out.reshape(*lead, w.shape[1]), (a, w)
+
+
+def _pcm_bwd(block_m, block_n, interpret, res, g):
+    a, w = res
+    g2 = g.reshape(-1, g.shape[-1])
+    a2 = a.reshape(-1, a.shape[-1])
+    da = lax.dot_general(g2, w, (((1,), (1,)), ((), ())))
+    dw = lax.dot_general(a2, g2, (((0,), (0,)), ((), ())))
+    return da.reshape(a.shape).astype(a.dtype), dw.astype(w.dtype)
+
+
+_pallas_chunk_matmul.defvjp(_pcm_fwd, _pcm_bwd)
+
+
+def all_gather_matmul_pallas(a, w_shard, axis_name: str, *,
+                             block_m: int | None = None,
+                             block_n: int | None = None,
+                             interpret: bool | None = None):
+    """Kernel-tier :func:`all_gather_matmul`: the same ring choreography
+    (shard hops stay ``lax.ppermute`` — the collective the contract
+    counts and the ledger prices), with each per-chunk tile matmul
+    running as a Pallas kernel instead of a traced ``@``.
+
+    On the CPU tier (``interpret=True``, the default off-TPU) the ring
+    hops cannot become in-kernel remote DMAs — interpret mode has no
+    inter-device copy — so the decomposition point is the per-chunk
+    matmul, and the default whole-chunk block makes the kernel's dot
+    bit-identical to the XLA path's (pinned by test).  On TPU the same
+    call sites tile via ``block_m``/``block_n``; folding the hop itself
+    into the kernel (``pltpu.make_async_remote_copy`` double-buffered
+    against the tile loop) is the recorded next step once a TPU BENCH
+    round can measure it.
+
+    AD: the ring scaffold stays plain traceable code (its transpose is
+    the reversed-ring matmul-reduce-scatter, as for the XLA variant);
+    only the chunk matmul carries a custom_vjp with XLA-dot backward."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = axis_size(axis_name)
+    if n == 1:   # degenerate ring: one whole-weight kernel call
+        return _pallas_chunk_matmul(a, w_shard, block_m, block_n,
+                                    interpret).astype(a.dtype)
+    k_chunk = w_shard.shape[0]
+    K = a.shape[-1]
+    if K != n * k_chunk:
+        raise ValueError(
+            f"all_gather_matmul_pallas: activation contraction dim {K} "
+            f"!= mesh axis {axis_name!r} size {n} x weight shard rows "
+            f"{k_chunk} — the shard must be a 1/{n} row-slice of the "
+            f"full weight (got shard shape {tuple(w_shard.shape)})")
+    idx = lax.axis_index(axis_name)
+    acc = jnp.zeros(a.shape[:-1] + (w_shard.shape[1],),
+                    jnp.promote_types(a.dtype, w_shard.dtype))
+    cur = w_shard
+    for t in range(n):
+        src = (idx - t) % n
+        a_chunk = lax.dynamic_slice_in_dim(a, src * k_chunk, k_chunk,
+                                           axis=a.ndim - 1)
+        acc = acc + _pallas_chunk_matmul(a_chunk, cur, block_m, block_n,
+                                         interpret)
         if t < n - 1:
             cur = lax.ppermute(cur, axis_name, _ring_perm(n))
     return acc.astype(a.dtype)

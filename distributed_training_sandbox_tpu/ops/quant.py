@@ -261,12 +261,18 @@ def _int8_dot(aq, a_scale, bq, b_scale, dims, out_dtype):
 
 # ------------------------------------------------------------- training
 
-def resolve_quantized_dense(precision: str):
+def resolve_quantized_dense(precision: str, *, fp8_history_len: int = 0):
     """``matmul_precision`` name → ``(a, w) -> out`` matmul, the ONE
     mapping shared by the attention projections (``transformer._dense``)
     and the per-expert MoE matmuls (``parallel.expert.moe_mlp``), so the
     same precision string always selects the same impl everywhere.
     ``"bf16"`` returns a plain matmul.
+
+    ``"fp8"`` / ``"fp8_pallas"`` select the e4m3-forward/e5m2-backward
+    recipe (:func:`fp8_dense`, XLA or Pallas forward kernel);
+    ``"fp8_delayed"`` additionally routes scaling through the
+    ``fp8_history_len``-deep amax history (the config's
+    ``fp8_amax_history_len`` axis).
 
     Every returned matmul also accepts a ``QuantizedWeight`` in the weight
     slot (decode's weight-static int8 storage) and routes it through
@@ -275,6 +281,13 @@ def resolve_quantized_dense(precision: str):
     model uses."""
     if precision == "bf16":
         base_fn = lambda a, w: a @ w  # noqa: E731
+    elif precision.startswith("fp8"):
+        impl = {"fp8": "xla", "fp8_delayed": "xla",
+                "fp8_pallas": "pallas"}[precision]
+        hist = (fp8_history_len or 16) if precision == "fp8_delayed" else 0
+        interpret = jax.default_backend() != "tpu"
+        base_fn = lambda a, w: fp8_dense(  # noqa: E731
+            a, w, impl, interpret, hist)
     else:
         base = precision.removesuffix("_bwd")
         impl = {"int8": "xla", "int8_pallas": "pallas_fused"}[base]
@@ -354,18 +367,23 @@ quantized_dense.defvjp(_qdense_fwd, _qdense_bwd)
 
 # ----------------------------------------------------- quantized gather
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def quantized_all_gather(x, axis_name: str, axis: int = 0):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def quantized_all_gather(x, axis_name: str, axis: int = 0,
+                         q8_bwd: bool = False):
     """All-gather a shard in int8 + per-row scales, dequantize after the
     wire: the twin of torchao's fp8 all-gather under FSDP2
     (``fp8_benchmark.py:79-81``; EQuARX explores the same trade for XLA).
     Backward is a full-precision psum_scatter (mean-free sum), matching
-    the plain all_gather transpose."""
-    out, _ = _qag_fwd(x, axis_name, axis)
+    the plain all_gather transpose — unless ``q8_bwd``, which quantizes
+    the gradient reduce-scatter too (:func:`quantized_reduce_scatter`),
+    putting BOTH directions of FSDP param traffic on int8 wire bytes
+    (the full EQuARX trade; grads then carry the documented
+    half-quantum-per-contribution error)."""
+    out, _ = _qag_fwd(x, axis_name, axis, q8_bwd)
     return out
 
 
-def _qag_fwd(x, axis_name, axis):
+def _qag_fwd(x, axis_name, axis, q8_bwd=False):
     if x.ndim == 1:
         # 1-D leaf (e.g. a norm scale): one scalar scale per shard,
         # re-applied segment-wise after the gather.
@@ -387,10 +405,283 @@ def _qag_fwd(x, axis_name, axis):
     return dequantize(qg, sg, x.dtype), None
 
 
-def _qag_bwd(axis_name, axis, res, g):
+def _qag_bwd(axis_name, axis, q8_bwd, res, g):
     # the gathered output has x's dtype, so g.dtype == x.dtype
+    if q8_bwd:
+        return (quantized_reduce_scatter(
+            g.astype(jnp.float32), axis_name,
+            axis=0 if g.ndim == 1 else axis).astype(g.dtype),)
     return (C.reduce_scatter(g.astype(jnp.float32), axis_name,
                              axis=axis).astype(g.dtype),)
 
 
 quantized_all_gather.defvjp(_qag_fwd, _qag_bwd)
+
+
+# --------------------------------------------- quantized all-reduce (EQuARX)
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def quantized_all_reduce(x, axis_name: str):
+    """EQuARX-style two-shot quantized all-reduce (arXiv:2506.17615):
+    each rank ships its partial sum as int8 codes + per-row f32 scales,
+    every rank dequantizes and sums the contributions in rank order.
+    ~4x fewer bus bytes than an f32 psum (int8 codes dominate; scales are
+    1/row), generalizing ``ddp.quantized_bucket_all_reduce``'s trick from
+    DDP grad buckets to TP rejoin and FSDP grad traffic.
+
+    Error bound: each rank's contribution carries symmetric-round error
+    ≤ half its quantum (scale/2 per element), so the summed result is
+    within ``n_ranks * max_scale / 2`` of ``lax.psum`` element-wise —
+    the documented per-contribution bound the tests assert.
+
+    Backward is pinned to psum's own transpose (a full-precision psum of
+    the cotangent), so only forward traffic is quantized — the same
+    asymmetry as ``quantized_all_gather``."""
+    out, _ = _qar_fwd(x, axis_name)
+    return out
+
+
+def _qar_quant(x):
+    """Per-row int8 codes + scales for an arbitrary-rank tensor: rows are
+    the last axis (a 0/1-D leaf quantizes as one row with one scale)."""
+    x_ = x.reshape(1, -1) if x.ndim < 2 else x
+    q, s = quantize_int8(x_, axis=-1)
+    return q, s
+
+
+def _qar_fwd(x, axis_name):
+    q, s = _qar_quant(x)
+    # two-shot: gather every rank's codes and scales (a new leading rank
+    # axis), dequantize-and-sum locally in rank order — deterministic
+    # reduction order, identical on every rank.
+    qg = C.all_gather(q, axis_name, axis=0, tiled=False)
+    sg = C.all_gather(s, axis_name, axis=0, tiled=False)
+    out = jnp.sum(qg.astype(jnp.float32) * sg, axis=0)
+    return out.reshape(x.shape).astype(x.dtype), None
+
+
+def _qar_bwd(axis_name, _res, g):
+    # lax.psum transposes to lax.psum: keep the quantized variant's
+    # backward identical to the baseline all-reduce's.
+    return (lax.psum(g, axis_name),)
+
+
+quantized_all_reduce.defvjp(_qar_fwd, _qar_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def quantized_reduce_scatter(x, axis_name: str, axis: int = 0):
+    """Two-shot quantized reduce-scatter — the FSDP grad-traffic leg of
+    the EQuARX trade: each rank quantizes its full partial tensor (int8
+    codes + per-row scales), an all_to_all routes chunk ``r`` of every
+    rank to rank ``r``, and the receiver dequantizes and sums its chunk
+    in rank order.  Same per-contribution half-quantum error bound as
+    :func:`quantized_all_reduce`; backward pinned to the monolithic
+    reduce-scatter's transpose (a full-precision all_gather)."""
+    out, _ = _qrs_fwd(x, axis_name, axis)
+    return out
+
+
+def _qrs_fwd(x, axis_name, axis):
+    n = C.axis_size(axis_name)
+    if x.ndim == 1:
+        # 1-D leaf: one scalar scale per rank, codes scattered by chunk.
+        if x.shape[0] % n:
+            raise ValueError(f"quantized_reduce_scatter: dim of size "
+                             f"{x.shape[0]} not divisible by axis "
+                             f"{axis_name!r} size {n}")
+        q, s = quantize_int8(x.reshape(1, -1), axis=-1)     # s: (1, 1)
+        qt = C.all_to_all(q.reshape(n, -1), axis_name, split_axis=0,
+                          concat_axis=0, tiled=False)        # (n, chunk)
+        sg = C.all_gather(s.reshape(1), axis_name, axis=0,
+                          tiled=False)                       # (n, 1)
+        out = jnp.sum(qt.astype(jnp.float32) * sg, axis=0)
+        return out.reshape(-1).astype(x.dtype), None
+    axis = axis % x.ndim
+    if x.shape[axis] % n:
+        raise ValueError(f"quantized_reduce_scatter: dim {axis} of size "
+                         f"{x.shape[axis]} not divisible by axis "
+                         f"{axis_name!r} size {n}")
+    # quantize along a dim that is NOT the scatter dim so each chunk's
+    # scales travel with its codes through the same all_to_all
+    qaxis = -1 if axis != x.ndim - 1 else 0
+    q, s = quantize_int8(x, axis=qaxis)
+
+    def route(t):
+        # rank-chunks of the scatter dim onto a new leading axis, then
+        # one all_to_all: rank r ends up holding every rank's chunk r,
+        # leading axis indexing the SOURCE rank (rank-order sum below)
+        c = t.shape[axis] // n
+        tr = t.reshape(t.shape[:axis] + (n, c) + t.shape[axis + 1:])
+        tr = jnp.moveaxis(tr, axis, 0)
+        return C.all_to_all(tr, axis_name, split_axis=0, concat_axis=0,
+                            tiled=False)
+
+    out = jnp.sum(route(q).astype(jnp.float32) * route(s), axis=0)
+    return out.astype(x.dtype), None
+
+
+def _qrs_bwd(axis_name, axis, _res, g):
+    if g.ndim == 1:
+        return (C.all_gather(g, axis_name, axis=0),)
+    return (C.all_gather(g, axis_name, axis=axis % g.ndim),)
+
+
+quantized_reduce_scatter.defvjp(_qrs_fwd, _qrs_bwd)
+
+
+# ------------------------------------------------------------------- fp8
+#
+# The other half of the reference's torchao sweep: real fp8 recipes
+# (``fp8/fp8_benchmark.py``: Float8Linear, e4m3 forward operands, e5m2
+# grad_output in backward, per-tensor dynamic or delayed amax scaling).
+# v5e still has no fp8 MXU mode, so like the int8 tier this ships as a
+# recipe-faithful CPU-tier implementation: operands make a REAL fp8
+# round-trip (jnp.float8_e4m3fn / float8_e5m2 storage — the quantization
+# noise is exactly fp8's), accumulation runs f32.  On fp8-capable
+# hardware the explicit upcast before the dot becomes a native fp8
+# ``dot_general`` — a one-line swap the RESULTS.md caveat records.
+
+FP8_FWD_DTYPE = jnp.float8_e4m3fn   # forward operands  (finfo max 448)
+FP8_BWD_DTYPE = jnp.float8_e5m2     # grad_output       (finfo max 57344)
+
+
+def fp8_max(dtype) -> float:
+    """Largest finite value of an fp8 dtype (448 for e4m3fn, 57344 for
+    e5m2) — the denominator of per-tensor absmax scaling."""
+    return float(jnp.finfo(dtype).max)
+
+
+def amax_history_update(history: jax.Array, x: jax.Array) -> jax.Array:
+    """Delayed-scaling bookkeeping: shift the tensor's current absmax
+    into the rolling (H,) f32 history (oldest entry drops off)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+    return jnp.concatenate([history[1:], amax[None]])
+
+
+def scale_from_history(history: jax.Array, dtype) -> jax.Array:
+    """Delayed scaling's scale choice: absmax over the whole rolling
+    history (torchao's ``delayed`` recipe) rather than just the current
+    tensor — robust to single-step amax spikes."""
+    amax = jnp.max(history)
+    return jnp.where(amax > 0, amax / fp8_max(dtype), 1.0)
+
+
+def quantize_fp8(x: jax.Array, dtype=FP8_FWD_DTYPE, *,
+                 amax_history_len: int = 0):
+    """Per-TENSOR absmax scaling to fp8 (Float8Linear's granularity —
+    coarser than the int8 tier's per-row scales): returns
+    ``(q fp8, scale f32 scalar)`` with ``dequant = q * scale``.
+
+    ``amax_history_len > 0`` routes the scale through the delayed-scaling
+    helpers.  This stateless CPU-tier instantiation seeds the history
+    with the current tensor's absmax (numerically identical to dynamic
+    scaling); a stateful trainer threads a real rolling history through
+    its train state and gets genuine delayed scaling from the same two
+    helpers."""
+    if amax_history_len:
+        hist = amax_history_update(
+            jnp.zeros((amax_history_len,), jnp.float32), x)
+        scale = scale_from_history(hist, dtype)
+    else:
+        amax = jnp.max(jnp.abs(x.astype(jnp.float32)))
+        scale = jnp.where(amax > 0, amax / fp8_max(dtype), 1.0)
+    fmax = fp8_max(dtype)
+    q = jnp.clip(x.astype(jnp.float32) / scale, -fmax, fmax).astype(dtype)
+    return q, scale
+
+
+def fp8_matmul(aq, a_scale, bq, b_scale, dims, out_dtype):
+    """Scaled dot over fp8-quantized operands, f32 accumulation, scalar
+    dequant epilogue.  The operands already carry fp8 round-trip noise;
+    the upcast before the dot is the CPU-tier stand-in for a native fp8
+    ``dot_general`` (see the section comment)."""
+    acc = lax.dot_general(aq.astype(jnp.float32), bq.astype(jnp.float32),
+                          dims, preferred_element_type=jnp.float32)
+    return (acc * a_scale * b_scale).astype(out_dtype)
+
+
+def _fp8_mm_kernel(aq_ref, as_ref, bq_ref, bs_ref, o_ref):
+    acc = jnp.dot(aq_ref[...].astype(jnp.float32),
+                  bq_ref[...].astype(jnp.float32),
+                  preferred_element_type=jnp.float32)
+    o_ref[...] = (acc * as_ref[0, 0] * bs_ref[0, 0]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("out_dtype", "block_m",
+                                             "block_n", "interpret"))
+def fp8_matmul_pallas(aq, a_scale, bq, b_scale, *, out_dtype=jnp.bfloat16,
+                      block_m: int | None = None,
+                      block_n: int | None = None,
+                      interpret: bool = False):
+    """Tiled Pallas twin of :func:`fp8_matmul` (2-D operands, per-tensor
+    scalar scales passed as (1, 1) blocks) — the fp8 leg of the kernel
+    tier, grid/BlockSpec structure of ``int8_matmul_pallas``."""
+    from jax.experimental import pallas as pl
+
+    M, K = aq.shape
+    K2, N = bq.shape
+    assert K == K2, (K, K2)
+    bm, bn = _auto_blocks(M, K, N, 1, block_m or 256, block_n or 512)
+    return pl.pallas_call(
+        _fp8_mm_kernel,
+        grid=(M // bm, N // bn),
+        in_specs=[
+            pl.BlockSpec((bm, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
+            pl.BlockSpec((K, bn), lambda i, j: (0, j)),
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        interpret=interpret,
+    )(aq, a_scale.reshape(1, 1), bq, b_scale.reshape(1, 1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def fp8_dense(x, w, impl: str = "xla", interpret: bool = False,
+              amax_history_len: int = 0):
+    """Linear layer with the Float8Linear recipe end-to-end: e4m3
+    per-tensor-scaled operands forward, and a backward whose THREE
+    operands split by role exactly as torchao's — grad_output quantizes
+    to e5m2 (wide range for gradient outliers), the saved activation and
+    weight re-quantize to e4m3 — so ALL step matmul FLOPs run at fp8
+    operand width.  ``impl``: "xla" or "pallas" (forward kernel;
+    backward stays XLA).  ``amax_history_len``: > 0 selects delayed
+    scaling (see :func:`quantize_fp8`)."""
+    out, _ = _fp8_dense_fwd(x, w, impl, interpret, amax_history_len)
+    return out
+
+
+def _fp8_dense_fwd(x, w, impl, interpret, hist):
+    lead = x.shape[:-1]
+    K = x.shape[-1]
+    x2 = x.reshape(-1, K)
+    xq, xs = quantize_fp8(x2, FP8_FWD_DTYPE, amax_history_len=hist)
+    wq, ws = quantize_fp8(w, FP8_FWD_DTYPE, amax_history_len=hist)
+    if impl == "pallas":
+        out = fp8_matmul_pallas(xq, xs, wq, ws, out_dtype=x.dtype,
+                                interpret=interpret)
+    else:
+        out = fp8_matmul(xq, xs, wq, ws, (((1,), (0,)), ((), ())),
+                         x.dtype)
+    return out.reshape(*lead, w.shape[1]), (x, w)
+
+
+def _fp8_dense_bwd(impl, interpret, hist, res, g):
+    x, w = res
+    lead = x.shape[:-1]
+    K, N = w.shape
+    g2 = g.reshape(-1, N)
+    x2 = x.reshape(-1, K)
+    gq, gs = quantize_fp8(g2, FP8_BWD_DTYPE, amax_history_len=hist)
+    # dX = g · Wᵀ (contraction over N): e5m2 grad × e4m3 weight
+    wq, ws = quantize_fp8(w, FP8_FWD_DTYPE, amax_history_len=hist)
+    gx = fp8_matmul(gq, gs, wq, ws, (((1,), (1,)), ((), ())), x.dtype)
+    # dW = Xᵀ · g (contraction over M): e4m3 activation × e5m2 grad
+    xq, xs = quantize_fp8(x2, FP8_FWD_DTYPE, amax_history_len=hist)
+    gw = fp8_matmul(xq, xs, gq, gs, (((0,), (0,)), ((), ())), w.dtype)
+    return gx.reshape(*lead, K), gw
+
+
+fp8_dense.defvjp(_fp8_dense_fwd, _fp8_dense_bwd)

@@ -159,34 +159,40 @@ def init_fsdp_opt_state(params_sharded, state_dtype=None):
 
 # ---------------------------------------------------------------- explicit
 
-OVERLAP_MODES = ("none", "ring", "ring_fused")
+OVERLAP_MODES = ("none", "ring", "ring_fused", "ring_fused_pallas")
 
 
 def _gather_leaf(x, spec: P, axis: str, quantized: bool = False,
-                 overlap: str = "none", fuse_matmul: bool = False):
+                 overlap: str = "none", fuse_matmul=False,
+                 quantized_grads: bool = False):
     """all_gather a shard back to full size along its sharded dim (no-op for
     leaves this axis doesn't shard).  ``quantized``: ship int8 + scales
     over the wire and dequantize after (the torchao fp8-all-gather twin,
     reference ``fp8/fp8_benchmark.py:79-81``).  Like torchao — which only
     low-precision-casts Linear weights — 1-D leaves (RMSNorm scales) stay
     in full precision: quantizing them saves negligible bandwidth and costs
-    outsized numerics.
+    outsized numerics.  ``quantized_grads`` additionally quantizes those
+    gathers' BACKWARD reduce-scatter (the EQuARX grad-traffic leg —
+    ``quant.quantized_reduce_scatter``).
 
     ``overlap="ring"``: the gather runs as the ppermute ring
     (``C.ring_all_gather``) — bitwise-identical values and grads, but
     n-1 schedulable hops instead of one monolithic collective.
-    ``fuse_matmul`` (ring_fused mode, layer-hook leaves only): a 2-D
-    projection weight sharded along its contraction dim is NOT gathered —
-    it returns as a :class:`C.RingShard` and the model's projection
-    matmul runs it as the decomposed ``all_gather_matmul``."""
+    ``fuse_matmul`` (ring_fused modes, layer-hook leaves only; False or
+    the chunk-matmul impl name): a 2-D projection weight sharded along
+    its contraction dim is NOT gathered — it returns as a
+    :class:`C.RingShard` and the model's projection matmul runs it as
+    the decomposed ``all_gather_matmul`` ("xla") or its Pallas
+    tile-kernel twin ("pallas")."""
     for dim, name in enumerate(spec):
         if name == axis:
             if quantized and x.ndim > 1:
                 from ..ops.quant import quantized_all_gather
-                return quantized_all_gather(x, axis, dim)
+                return quantized_all_gather(x, axis, dim, quantized_grads)
             if fuse_matmul and x.ndim == 2 and dim == 0:
-                return C.RingShard(x, axis)
-            if overlap in ("ring", "ring_fused"):
+                return C.RingShard(
+                    x, axis, "pallas" if fuse_matmul == "pallas" else "xla")
+            if overlap in ("ring", "ring_fused", "ring_fused_pallas"):
                 return C.ring_all_gather(x, axis, dim)
             return C.all_gather(x, axis, axis=dim)
     return x
@@ -237,6 +243,7 @@ def make_fsdp_train_step(
     *,
     reshard_after_forward: bool = True,
     quantized_gather: bool = False,
+    quantized_grads: bool = False,
     overlap: str = "none",
     accum_steps: int = 1,
     offload: str = "none",
@@ -279,9 +286,18 @@ def make_fsdp_train_step(
     "ring_fused" = 2-D projection weights stay sharded and their matmuls
     run as decomposed ``all_gather_matmul`` collective matmuls
     (numerically equivalent, not bitwise: the chunked contraction
-    re-associates the K-sum).  ring_fused requires the per-layer gather
-    seam (reshard_after_forward=True), a dense model, and full-precision
-    gathers.
+    re-associates the K-sum); "ring_fused_pallas" = the same choreography
+    with each per-chunk tile matmul lowered through the Pallas kernel
+    (``ops.collectives.all_gather_matmul_pallas`` — bitwise-identical to
+    ring_fused at whole-chunk blocks).  Both fused modes require the
+    per-layer gather seam (reshard_after_forward=True), a dense model,
+    and full-precision gathers.
+
+    ``quantized_grads`` (requires ``quantized_gather``): the quantized
+    gathers' backward reduce-scatter also runs two-shot int8 on the wire
+    (``ops.quant.quantized_reduce_scatter`` — the EQuARX grad-traffic
+    leg; ~4x fewer backward bus bytes, per-contribution half-quantum
+    error bound).
 
     ``accum_steps``: microbatched gradient accumulation —
     ``lax.scan`` over accum_steps splits of the batch with a donated
@@ -303,21 +319,25 @@ def make_fsdp_train_step(
     if overlap not in OVERLAP_MODES:
         raise ValueError(f"overlap={overlap!r}; choose from "
                          f"{OVERLAP_MODES}")
-    if overlap == "ring_fused":
+    if overlap.startswith("ring_fused"):
         if quantized_gather:
-            raise ValueError("overlap='ring_fused' fuses full-precision "
+            raise ValueError(f"overlap={overlap!r} fuses full-precision "
                              "collective matmuls; it does not compose "
                              "with quantized_gather (use overlap='ring')")
         if not reshard_after_forward:
-            raise ValueError("overlap='ring_fused' needs the per-layer "
+            raise ValueError(f"overlap={overlap!r} needs the per-layer "
                              "gather seam — reshard_after_forward=False "
                              "keeps gathered weights live, which "
                              "contradicts fused re-ringing")
         if getattr(cfg, "n_experts", 0):
-            raise ValueError("overlap='ring_fused' covers dense "
+            raise ValueError(f"overlap={overlap!r} covers dense "
                              "projection leaves only; MoE expert leaves "
                              "shard their expert dim, not a contraction "
                              "dim (use overlap='ring')")
+    if quantized_grads and not quantized_gather:
+        raise ValueError("quantized_grads quantizes the backward "
+                         "reduce-scatter of the quantized gathers; it "
+                         "requires quantized_gather=True")
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     from ..memory_plan.offload import (
@@ -346,13 +366,15 @@ def make_fsdp_train_step(
     hook_specs = jax.tree.map(lambda s: P(*s[1:]), layer_specs,
                               is_leaf=lambda x: isinstance(x, P))
 
-    fuse = overlap == "ring_fused"
+    fuse = {"ring_fused": "xla", "ring_fused_pallas": "pallas"}.get(
+        overlap, False)
 
     def layer_hook(layer):
         with scope("fsdp_layer_gather"):
             return _spec_map(
                 lambda x, s: _gather_leaf(x, s, axis, quantized_gather,
-                                          overlap, fuse_matmul=fuse),
+                                          overlap, fuse_matmul=fuse,
+                                          quantized_grads=quantized_grads),
                 layer, hook_specs)
 
     def step(shards, opt_state, batch):
@@ -363,7 +385,8 @@ def make_fsdp_train_step(
             # projection operand.
             with scope("fsdp_root_gather"):
                 outer = {k: _gather_leaf(v, specs[k], axis,
-                                         quantized_gather, overlap)
+                                         quantized_gather, overlap,
+                                         quantized_grads=quantized_grads)
                          for k, v in shards.items() if k != "layers"}
             if reshard_after_forward:
                 params = {**outer, "layers": shards["layers"]}
@@ -373,8 +396,9 @@ def make_fsdp_train_step(
             # 1849 tok/s knob, train_fsdp.py:85-86).
             with scope("fsdp_pre_gather_layers"):
                 full_layers = _spec_map(
-                    lambda x, s: _gather_leaf(x, s, axis,
-                                              quantized_gather, overlap),
+                    lambda x, s: _gather_leaf(
+                        x, s, axis, quantized_gather, overlap,
+                        quantized_grads=quantized_grads),
                     shards["layers"], layer_specs)
             params = {**outer, "layers": full_layers}
             return base_loss(params, batch, cfg, layer_hook=None)

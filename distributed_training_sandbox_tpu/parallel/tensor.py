@@ -132,20 +132,24 @@ def make_tp_train_step(
 
     ``overlap="ring"``: the per-layer row-parallel rejoin psums run
     decomposed (psum_scatter + ring all-gather) — bitwise-identical
-    loss/grads, tp-1 schedulable hops per rejoin.  Applies to the
-    default ``tp_lm_loss`` only (a custom ``loss_fn`` owns its own
+    loss/grads, tp-1 schedulable hops per rejoin.  ``overlap="q8"``:
+    the rejoin psums run as EQuARX two-shot quantized all-reduces
+    (``ops.quant.quantized_all_reduce`` — int8 codes + scales on the
+    wire, ~4x fewer bus bytes, per-contribution half-quantum error
+    bound; grad psums stay full-precision).  Both apply to the default
+    ``tp_lm_loss`` only (a custom ``loss_fn`` owns its own
     collectives).  ``accum_steps``: microbatched gradient accumulation
     over leading-dim batch splits (``fsdp.microbatch_value_and_grad``)."""
     ws_dp = int(mesh.shape[dp_axis])
     ws_tp = int(mesh.shape[tp_axis])
     check_tp_divisibility(cfg, ws_tp)
-    if overlap not in ("none", "ring"):
+    if overlap not in ("none", "ring", "q8"):
         raise ValueError(f"overlap={overlap!r}; the tp step supports "
-                         f"'none' or 'ring'")
-    if overlap == "ring" and loss_fn is not None:
-        raise ValueError("overlap='ring' rewires tp_lm_loss's rejoin "
-                         "psums; a custom loss_fn owns its own "
-                         "collectives — decompose them there instead")
+                         f"'none', 'ring' or 'q8'")
+    if overlap != "none" and loss_fn is not None:
+        raise ValueError(f"overlap={overlap!r} rewires tp_lm_loss's "
+                         "rejoin psums; a custom loss_fn owns its own "
+                         "collectives — rewire them there instead")
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if sp_axis is None and cfg.sp_axis is not None:

@@ -85,7 +85,8 @@ def _apply_rope_ragged(x, cos, sin):
 
 
 def _paged_layer_body(x, layer, *, cfg, cos, sin, use_rope, pk, pv,
-                      pk_s, pv_s, pages, apos, valid, tp_axis=None):
+                      pk_s, pv_s, pages, apos, valid, tp_axis=None,
+                      paged_kernel=False):
     """One decoder layer against the PAGED pool — the numerics of
     ``generate._cached_layer_body`` with scatter/gather storage:
 
@@ -137,6 +138,32 @@ def _paged_layer_body(x, layer, *, cfg, cos, sin, use_rope, pk, pv,
         pk = pk.at[pg, off].set(k)
         pv = pv.at[pg, off].set(v)
 
+    if paged_kernel and S == 1:
+        # Pallas decode kernel: pages are read IN PLACE via the table —
+        # the (B, V, nkv, hd) gather view below never materializes.
+        # Bitwise-equal to the gather path (ops/paged_attention.py).
+        from ..ops.paged_attention import paged_attention_decode
+        rep = nq // nkv
+        qg = q.reshape(B, S, nkv, rep, hd)
+        if quantized:
+            qq, q_s = _quant_kv(qg)
+            attn = paged_attention_decode(
+                qq, pk, pv, pages, apos, q_scale=q_s,
+                pk_s=pk_s, pv_s=pv_s)
+        else:
+            attn = paged_attention_decode(qg, pk, pv, pages, apos,
+                                          probs_dtype=x.dtype)
+        attn = attn.astype(x.dtype).reshape(B, S, nq * hd)
+        attn_out = dense(attn, layer["wo"])
+        if tp_axis:
+            attn_out = C.all_reduce(attn_out, tp_axis)
+        x = x + attn_out
+        r = T.rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
+        mlp, _aux = T._mlp_block(r, layer, cfg=cfg)
+        if tp_axis:
+            mlp = C.all_reduce(mlp, tp_axis)
+        return x + mlp, (pk, pv, pk_s, pv_s)
+
     # gather the slot's pages into the contiguous head-major view the
     # attention contracts over — fixed extent V for every request, the
     # parity-bearing choice (see module docstring)
@@ -186,7 +213,7 @@ def _paged_layer_body(x, layer, *, cfg, cos, sin, use_rope, pk, pv,
 
 
 def _paged_forward(params, ids, cfg, bufs: PoolBuffers, pages, apos,
-                   valid, tp_axis=None):
+                   valid, tp_axis=None, paged_kernel=False):
     """ids (B, S) → (hidden x (B, S, H), bufs') through the UNROLLED
     layer stack (static layer index into the per-layer pools, like
     ``generate._forward_cached``)."""
@@ -206,7 +233,8 @@ def _paged_forward(params, ids, cfg, bufs: PoolBuffers, pages, apos,
             pk=ks[li], pv=vs[li],
             pk_s=kss[li] if kss is not None else None,
             pv_s=vss[li] if vss is not None else None,
-            pages=pages, apos=apos, valid=valid, tp_axis=tp_axis)
+            pages=pages, apos=apos, valid=valid, tp_axis=tp_axis,
+            paged_kernel=paged_kernel)
         if kss is not None:
             kss[li], vss[li] = ksc, vsc
     out = PoolBuffers(k=tuple(ks), v=tuple(vs),
@@ -229,7 +257,7 @@ def _last_logits(params, x_last, cfg):
 
 
 def _decode_core(bufs, params, pages, toks, lengths, stop_at, active, *,
-                 cfg, tp_axis=None):
+                 cfg, tp_axis=None, paged_kernel=False):
     """One fixed-shape decode step over every slot.  toks/lengths/
     stop_at (B,) int32, active (B,) bool.  Emits the next greedy token
     per ACTIVE slot (inactive slots freeze); a slot auto-retires ON
@@ -238,7 +266,8 @@ def _decode_core(bufs, params, pages, toks, lengths, stop_at, active, *,
     observes retirement at the next sync."""
     apos = lengths[:, None]
     x, bufs = _paged_forward(params, toks[:, None], cfg, bufs, pages,
-                             apos, active[:, None], tp_axis=tp_axis)
+                             apos, active[:, None], tp_axis=tp_axis,
+                             paged_kernel=paged_kernel)
     logits = _last_logits(params, x[:, -1:], cfg)
     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     nxt = jnp.where(active, nxt, toks)
@@ -270,18 +299,24 @@ def _prefill_core(bufs, params, pages_row, ids, pos, plen, *, cfg,
 # ------------------------------------------------------------- step builders
 
 def make_serve_decode_step(cfg, params=None, *, mesh=None,
-                           tp_axis: str = "tp", pool_spec=None):
+                           tp_axis: str = "tp", pool_spec=None,
+                           paged_kernel: bool = False):
     """The jitted fixed-shape decode step, donated pool buffers.
     ``mesh`` selects the tensor-parallel shard_map wrapping (params must
     then be the tree ``parallel.tensor.tp_specs`` describes and
-    ``pool_spec`` the pool's PartitionSpec pytree)."""
+    ``pool_spec`` the pool's PartitionSpec pytree).  ``paged_kernel``
+    routes attention through the Pallas decode kernel
+    (``ops/paged_attention.py`` — pages read in place via the table, no
+    contiguous gather view; bitwise-equal outputs)."""
     cfg = _decode_cfg(cfg)
     if mesh is None:
-        return jax.jit(partial(_decode_core, cfg=cfg, tp_axis=None),
+        return jax.jit(partial(_decode_core, cfg=cfg, tp_axis=None,
+                               paged_kernel=paged_kernel),
                        donate_argnums=(0,))
     from jax.sharding import PartitionSpec as P
     from ..parallel.tensor import tp_specs
-    core = partial(_decode_core, cfg=cfg, tp_axis=tp_axis)
+    core = partial(_decode_core, cfg=cfg, tp_axis=tp_axis,
+                   paged_kernel=paged_kernel)
     in_specs = (pool_spec, tp_specs(params, tp_axis), P(), P(), P(),
                 P(), P())
     out_specs = (P(), P(), P(), pool_spec, P())
@@ -327,6 +362,7 @@ class ServingEngine:
                  prefill_chunks_per_round: int = 2,
                  sync_every: int = 4, max_in_flight: int = 8,
                  kv_quant: bool = False,
+                 paged_kernel: bool = False,
                  hbm_budget_gb: float | None = None,
                  disaggregate: bool = False, device=None,
                  watchdog=None, telem=None):
@@ -342,6 +378,10 @@ class ServingEngine:
         self.sync_every = max(int(sync_every), 1)
         self.max_in_flight = int(max_in_flight)
         self.kv_quant = bool(kv_quant)
+        # decode attention through the Pallas paged kernel (pages read
+        # in place via the table — ops/paged_attention.py); prefill
+        # (S > 1) keeps the gather path
+        self.paged_kernel = bool(paged_kernel)
         self.mesh = mesh
         self.tp_axis = tp_axis if mesh is not None else None
         self.telem = telem
@@ -427,7 +467,8 @@ class ServingEngine:
 
         self._decode = make_serve_decode_step(
             self.cfg, self._params, mesh=mesh, tp_axis=tp_axis,
-            pool_spec=self.pool.spec if mesh is not None else None)
+            pool_spec=self.pool.spec if mesh is not None else None,
+            paged_kernel=self.paged_kernel)
         self._prefill = make_serve_prefill_step(
             self.cfg, self._params_pre, mesh=mesh, tp_axis=tp_axis,
             pool_spec=self.pool.spec if mesh is not None else None)

@@ -30,15 +30,16 @@ class TunerCandidate:
     matmul_precision: str = "bf16"
     state_precision: str = "full"
     offload: str = "none"
-    overlap: str = "none"          # "none" | "ring" | "ring_fused"
+    overlap: str = "none"   # "none"|"ring"|"ring_fused"|"ring_fused_pallas"
     sync_every: int = 0            # 0 = pump default (no per-step sync)
     bucket_mb: float | None = None  # DDP-family bucket size
 
     # ------------------------------------------------------------ names
     def bench_name(self) -> str:
         """The ``bench.py`` row name for this candidate, in the grammar
-        ``parse_bench_config_name`` reads back (explicit[_remat][_int8_bwd]
-        [_s8][_b{N}x]).  Knobs the bench grammar has no token for
+        ``parse_bench_config_name`` reads back (explicit[_remat]
+        [_int8_bwd|_fp8(_delayed|_pallas)][_s8][_b{N}x]).  Knobs the
+        bench grammar has no token for
         (accum, offload, overlap, sync) get trailing tags — such names
         parse to None, which is correct: no measured bench row covers
         them."""
@@ -47,6 +48,8 @@ class TunerCandidate:
             parts.append(self.remat_policy)
         if self.matmul_precision == "int8_bwd":
             parts.append("int8_bwd")
+        elif self.matmul_precision.startswith("fp8"):
+            parts.append(self.matmul_precision)
         if self.state_precision == "int8":
             parts.append("s8")
         if self.batch_scale > 1:
@@ -102,7 +105,8 @@ _DEFAULT_AXES = dict(
     batch_scale=(1, 2, 4, 8),
     accum_steps=(1, 2),
     remat_policy=REMAT_POLICIES,
-    matmul_precision=("bf16", "int8_bwd"),
+    matmul_precision=("bf16", "int8_bwd", "fp8", "fp8_delayed",
+                      "fp8_pallas"),
     state_precision=("full", "int8"),
     offload=("none", "opt"),
     overlap=("none",),

@@ -215,12 +215,15 @@ def main(argv=None) -> int:
         findings = lint_tree(args.scripts_dir)
         # the package tree gets the swallowed-distributed-error check
         # too: a silent `except Exception: pass` around a collective in
-        # library code is exactly as hang-prone as one in a script
+        # library code is exactly as hang-prone as one in a script —
+        # plus the pallas-call-no-interpret check: every kernel wrapper
+        # in library code must plumb the CPU-tier interpret knob
         pkg_dir = Path(args.scripts_dir).resolve().parent \
             / "distributed_training_sandbox_tpu"
         if pkg_dir.is_dir():
             findings += lint_tree(pkg_dir, recursive=True,
-                                  checks={"swallowed-distributed-error"})
+                                  checks={"swallowed-distributed-error",
+                                          "pallas-call-no-interpret"})
         # the serving modules additionally get the host-sync lint: the
         # engine/fleet hot path may only block at its declared sync
         # points (each carries a `# sync-ok` pragma) — an undeclared

@@ -6,8 +6,13 @@ model/precision/seq/devices (``fp8_benchmark.py:151-188``).
 
 v5e has no fp8 units, so the low-precision twin is int8 with dynamic
 absmax scaling (``--precision int8``; ``int8_pallas`` routes the matmuls
-through the hand-tiled Pallas kernel).  ``--sweep`` reproduces the
-seq×precision grid of ``fp8/modal_app.py:90-110``.
+through the hand-tiled Pallas kernel).  The fp8 tier proper is now also
+wired (``fp8`` = e4m3 fwd / e5m2 bwd per-tensor dynamic scaling,
+``fp8_delayed`` = amax-history delayed scaling, ``fp8_pallas`` = the
+tiled Pallas fp8 kernel) — off-TPU these run the emulated upcast dot,
+so treat their numbers as recipe-overhead, not fp8-unit speedups.
+``--sweep`` reproduces the seq×precision grid of
+``fp8/modal_app.py:90-110`` extended to the full bf16/int8/fp8 grid.
 
 ``--batch-sweep`` additionally crosses each (seq, precision) cell with
 batch ∈ {1, 2, 4, 8} (stopping the doubling at the first OOM and
@@ -40,8 +45,11 @@ from distributed_training_sandbox_tpu.utils import classify_failure  # noqa: E40
 
 SWEEP_SEQS = (2048, 4096, 8192)           # fp8/modal_app.py:90
 # {bf16, fp8} in the reference (fp8/modal_app.py:90-110); the v5e twin adds
-# the full-int8 recipe (backward matmuls quantized too) as the headline.
-SWEEP_PRECISIONS = ("bf16", "int8", "int8_bwd")
+# the full-int8 recipe (backward matmuls quantized too) plus the fp8
+# tier proper (e4m3 fwd / e5m2 bwd per-tensor scaling, ops/quant.py —
+# emulated-dot numbers off-TPU: the CPU tier upcasts fp8 operands).
+SWEEP_PRECISIONS = ("bf16", "int8", "int8_bwd", "fp8", "fp8_delayed",
+                    "fp8_pallas")
 SWEEP_BATCHES = (1, 2, 4, 8)
 
 
@@ -140,7 +148,8 @@ def main(argv=None):
     p.add_argument("--model", choices=sorted(MODELS), default="tiny")
     p.add_argument("--precision",
                    choices=["bf16", "int8", "int8_pallas", "int8_bwd",
-                            "int8_pallas_bwd"], default="bf16")
+                            "int8_pallas_bwd", "fp8", "fp8_delayed",
+                            "fp8_pallas"], default="bf16")
     p.add_argument("--sequence-length", type=int, default=None)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--num-steps", type=int, default=12)

@@ -27,6 +27,15 @@ from jax.sharding import Mesh  # noqa: E402
 REPO = Path(__file__).resolve().parent.parent
 
 
+def pytest_collection_modifyitems(config, items):
+    """Run the ``kernels`` tier last.  The Pallas interpret-mode tests are
+    the most expensive single file in the suite (step-level fp8/fused-kernel
+    parity plus profiled contract smokes); appending them keeps the fast
+    suites' ordering — and their position inside a wall-clock CI budget —
+    identical to what it was before the tier landed."""
+    items.sort(key=lambda it: 1 if it.get_closest_marker("kernels") else 0)
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     assert len(jax.devices()) == 8, "expected 8 simulated CPU devices"
