@@ -75,6 +75,15 @@ traces it), tuned so the current ``scripts/`` tree is clean at the
     from a provably closed set marks the call line — or the line above
     — with ``# span-ok``.
 
+  * ``mem-stats-in-hot-loop`` (warn) — ``memory_stats()`` /
+    ``device_memory_stats()`` inside a Python loop of a ``*step*``
+    function: the allocator query is a host round-trip, so polling it
+    per iteration is a host-sync landmine (the exact pattern
+    ``PerformanceTracker`` replaced with guarded sampling).  Route the
+    read through ``telemetry.memledger.get_sampler()`` — or any
+    every-N/finalize-only guard — and mark a deliberate per-iteration
+    poll with ``# mem-ok``.
+
 Findings carry a severity; ``scripts/lint_sharding.py`` fails the run
 only on errors (``--strict`` promotes warnings).
 """
@@ -105,6 +114,10 @@ SHARD_WRAPPERS = {"shard_map", "smap", "pmap", "shmap", "xmap"}
 # per-step host synchronization calls — the pattern the runtime step
 # pump's sync policy replaces in driver hot loops
 HOST_SYNC_FNS = {"block_until_ready", "local_scalar"}
+# allocator-stats queries (each one a device round-trip) — polling them
+# inside a *step* hot loop is the pattern the memory ledger's shared
+# sampler replaces
+MEM_STATS_FNS = {"memory_stats", "device_memory_stats"}
 # opening an Orbax manager; and the names whose presence anywhere in the
 # file counts as a guaranteed wait_until_finished-on-exit
 CKPT_OPENERS = {"checkpoint_manager", "CheckpointManager"}
@@ -176,6 +189,7 @@ class _Visitor(ast.NodeVisitor):
         self.swallowed: list[tuple[int, str]] = []
         self.dynamic_emit_names: list[tuple[int, str]] = []
         self.pallas_no_interpret: list[tuple[int, str]] = []
+        self.mem_stats_in_loop: list[tuple[int, str]] = []
 
     # -- context tracking -------------------------------------------------
     def _visit_function(self, node):
@@ -266,6 +280,10 @@ class _Visitor(ast.NodeVisitor):
             self.ckpt_opens.append((node.lineno, chain))
         if leaf in CKPT_GUARDS:
             self.has_ckpt_guard = True
+        if (leaf in MEM_STATS_FNS and self._loop_depth
+                and not self._jit_depth
+                and any("step" in n.lower() for n in self._fn_stack)):
+            self.mem_stats_in_loop.append((node.lineno, chain or leaf))
         if self._loop_depth and not self._jit_depth:
             self._check_host_sync(node, chain, leaf, root)
         if _is_jit_call(node):
@@ -442,6 +460,16 @@ def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
             f"cannot run it; plumb an interpret knob through the "
             f"wrapper (default jax.default_backend() != 'tpu'), or "
             f"mark a deliberate compile-only site with '# pallas-ok'"))
+    for line, chain in v.mem_stats_in_loop:
+        if _pragma(line, "mem-ok"):
+            continue
+        findings.append(PitfallFinding(
+            path, line, "mem-stats-in-hot-loop", SEV_WARN,
+            f"{chain}() inside a *step* hot loop — each allocator query "
+            f"is a host round-trip; sample through the memory ledger's "
+            f"shared sampler (telemetry.memledger.get_sampler) or an "
+            f"every-N guard, or mark a deliberate per-iteration poll "
+            f"with '# mem-ok'"))
     for line, chain in v.dynamic_emit_names:
         if _pragma(line, "span-ok"):
             continue

@@ -35,8 +35,10 @@ from .planner import (  # noqa: F401
     plan,
 )
 from .predictor import (  # noqa: F401
+    MEMORY_PRIORS_SCHEMA_VERSION,
     WaterlinePrediction,
     analytic_waterline,
+    load_memory_priors,
     predict,
     predict_from_step,
 )

@@ -23,9 +23,31 @@ Two sources, in order of authority:
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
 
 from ..utils.memory import GB, parse_hbm_oom
+
+# schema of the measured-residual priors file that
+# ``scripts/runs.py export-memory-priors`` emits from indexed memory
+# ledgers (telemetry.memledger) — the memory twin of the tuner's
+# cost_model.json
+MEMORY_PRIORS_SCHEMA_VERSION = 1
+
+
+def load_memory_priors(path: str) -> dict | None:
+    """Parse an ``export-memory-priors`` file; None when missing,
+    unreadable, or from a different schema generation (recalibration
+    must never crash a planner run)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(doc, dict) or \
+            doc.get("schema_version") != MEMORY_PRIORS_SCHEMA_VERSION:
+        return None
+    return doc
 
 
 @dataclass
@@ -105,7 +127,8 @@ def _per_token_dot_bytes(cfg, itemsize: int) -> int:
 def analytic_waterline(cfg, *, batch: int, seq: int, ws: int = 1,
                        accum_steps: int = 1, state_precision: str = "full",
                        offload: str = "none", dense_grads: bool = True,
-                       capacity_gb: float | None = None
+                       capacity_gb: float | None = None,
+                       priors: dict | None = None
                        ) -> WaterlinePrediction:
     """Tensor-walk waterline model for one FSDP-style train step of
     ``cfg`` (any ``TransformerConfig``-shaped object) at global ``batch``
@@ -117,7 +140,14 @@ def analytic_waterline(cfg, *, batch: int, seq: int, ws: int = 1,
     buffers — layer workspace and loss-phase buffers never coexist, but
     remat-saved tensors live through both.  Optimizer state under
     ``offload`` in ("opt", "opt_act") counts one stacked-leaf pair of
-    streaming headroom instead of full residency."""
+    streaming headroom instead of full residency.
+
+    ``priors`` is an ``export-memory-priors`` dict (see
+    :func:`load_memory_priors`): its ``overall_ratio`` — median
+    measured-ledger peak over analytic prediction across indexed runs —
+    rescales the total the same way bench priors anchor the tuner, so
+    the model recalibrates against ground truth without reweighing its
+    own terms."""
     itemsize = _dtype_size(getattr(cfg, "dtype", "bfloat16"))
     P = cfg.param_count() if hasattr(cfg, "param_count") else 0
     params = P * itemsize / ws
@@ -194,7 +224,16 @@ def analytic_waterline(cfg, *, batch: int, seq: int, ws: int = 1,
         "layer_working": working / GB, "loss": loss / GB,
         "batch": batch_bytes / GB,
     }
-    return WaterlinePrediction(gb=total / GB, source="analytic",
+    gb = total / GB
+    if priors:
+        try:
+            ratio = float(priors.get("overall_ratio") or 0.0)
+        except (TypeError, ValueError):
+            ratio = 0.0
+        if ratio > 0:
+            gb *= ratio
+            comp["priors_ratio"] = ratio
+    return WaterlinePrediction(gb=gb, source="analytic",
                                components=comp).judge(capacity_gb)
 
 

@@ -458,6 +458,21 @@ class ServingEngine:
         self.pool = PagedKVPool(self.cfg, self.n_pages, self.page_size,
                                 kv_quant=self.kv_quant, mesh=mesh,
                                 tp_axis=tp_axis, device=self._decode_dev)
+        # the serving-side waterline prediction the memory ledger joins:
+        # accounting's weights+pool model vs the decode program's own
+        # memory_analysis() (attached at the first decode burst)
+        from ..utils.memory import GB, tree_size_bytes
+        from .accounting import serve_waterline_gb
+        _wb = tree_size_bytes(self._params)
+        _pool_b = tree_size_bytes(self.pool.bufs)
+        self._mem_prediction = {
+            "predicted_gb": round(serve_waterline_gb(
+                self.cfg, self.n_pages, self.page_size, weight_bytes=_wb,
+                kv_quant=self.kv_quant, tp=tp), 3),
+            "source": "serve_accounting",
+            "components": {"weights": round(_wb / GB, 3),
+                           "kv_pool": round(_pool_b / GB, 3)},
+        }
         self.pool_pre = None
         if self.disaggregate:
             self.pool_pre = PagedKVPool(
@@ -685,7 +700,10 @@ class ServingEngine:
             # text at this burst's exact arg shardings
             self.telem.attach_step_hlo(self._decode, bufs, self._params,
                                        pages_d, toks_d, len_d, stop_d,
-                                       act_d)
+                                       act_d,
+                                       trees={"kv_pool": bufs,
+                                              "params": self._params},
+                                       prediction=self._mem_prediction)
         t_burst = time.perf_counter()
         step_tokens = []
         for _ in range(sync):

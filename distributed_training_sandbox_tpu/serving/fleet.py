@@ -81,8 +81,8 @@ class _ReplicaTelem:
         kw.setdefault("replica", self.replica)
         return self._telem.step(**kw)
 
-    def attach_step_hlo(self, jitted, *args):
-        return self._telem.attach_step_hlo(jitted, *args)
+    def attach_step_hlo(self, jitted, *args, **kw):
+        return self._telem.attach_step_hlo(jitted, *args, **kw)
 
 
 class Replica:

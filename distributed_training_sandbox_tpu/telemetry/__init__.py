@@ -21,7 +21,12 @@ Every training script emits the same three artifacts under
     compiled collective instruction, measured duration + payload bytes
     + achieved algo/bus GB/s, joined against the strategy's
     CollectiveContract (the measured verdict also lands in
-    ``manifest.json`` beside the static one).
+    ``manifest.json`` beside the static one);
+  * ``memory.json``    — the :mod:`.memledger` MemoryLedger: the compiled
+    step's ``memory_analysis()`` waterline attributed to categories
+    (params / opt-state / saved activations / collective scratch) plus
+    the phase-spanned allocator timeline; its MemoryVerdict — measured
+    peak vs planner prediction — is the third manifest mark.
 
 ``scripts/report.py`` reads these back for the cross-run side-by-side
 table and regression deltas — the ICI half of the NCCL-vs-ICI
@@ -61,6 +66,18 @@ from .ledger import (  # noqa: F401
     join_contract,
     ledger_from_trace,
     load_ledger_dict,
+)
+from .memledger import (  # noqa: F401
+    MEMORY_FILENAME,
+    MemoryLedger,
+    MemorySampler,
+    build_memory_ledger,
+    check_memory_regressions,
+    get_sampler,
+    join_prediction,
+    load_memory_dict,
+    memory_aggregates,
+    phase_for_span,
 )
 from .run import TelemetryRun  # noqa: F401
 from .report import (  # noqa: F401

@@ -9,12 +9,13 @@ the choreography fingerprint that lets the report CLI show "N
 all-reduces/step" next to step time.
 
 The startup fields are immutable.  When the run owned a profiler,
-``TelemetryRun.finalize`` rewrites the file exactly once to append two
+``TelemetryRun.finalize`` rewrites the file exactly once to append the
 measured-side fields: ``profile_sessions`` (the exact profiler session
 dirs this run created — trace ownership, so analysis never grabs a
-concurrent run's newer trace) and ``ledger`` (the trace-measured
+concurrent run's newer trace), ``ledger`` (the trace-measured
 contract verdict from ``telemetry.ledger``, beside the static
-``contract`` verdict it mirrors).
+``contract`` verdict it mirrors) and ``memory`` (the MemoryVerdict from
+``telemetry.memledger`` — the measured-waterline third mark).
 """
 
 from __future__ import annotations
@@ -85,6 +86,10 @@ class RunManifest:
     # measured collective-ledger verdict beside the static contract one
     profile_sessions: list | None = None
     ledger: dict | None = None
+    # the memory ledger's MemoryVerdict (telemetry.memledger): measured
+    # allocator peak joined to the compiled memory_analysis() waterline
+    # and, where the driver passed one, the planner prediction
+    memory: dict | None = None
     extra: dict = field(default_factory=dict)
 
     @classmethod

@@ -180,6 +180,22 @@ def run_row(rec: dict) -> dict:
         cj = ld.get("contract_join") or {}
         if "ok" in cj:
             row.setdefault("ledger_ok", cj["ok"])
+    # memory ledger (telemetry.memledger): the MemoryVerdict — measured
+    # allocator peak vs compiled memory_analysis() waterline vs planner
+    # prediction — plus flattened per-category aggregates from
+    # memory.json, feeding the measured-vs-predicted table and the
+    # --fail-on-memory-regression gate
+    mv = summ.get("memory") or man.get("memory") or {}
+    if mv:
+        row["memory_verdict"] = mv
+        if "ok" in mv:
+            row["memory_ok"] = mv.get("ok")
+        if mv.get("measured_gb") is not None:
+            row["measured_peak_gb"] = mv["measured_gb"]
+    from .memledger import load_memory_dict, memory_aggregates
+    md = load_memory_dict(rec["dir"])
+    if md:
+        row["memory_aggregates"] = memory_aggregates(md)
     return row
 
 
@@ -244,11 +260,14 @@ def _fmt(v, spec=".1f") -> str:
 
 
 def _mem_cell(r: dict) -> str:
-    """Memory column: the compiler-reported waterline when the run was
-    planned, else the analytic prediction (``~`` prefix), else the
-    tracker's sampled allocator peak; budget appended when one gated the
-    run."""
-    if r.get("compiled_gb") is not None:
+    """Memory column: the memory ledger's measured peak when one was
+    filed (measured beats modeled), else the compiler-reported waterline
+    when the run was planned, else the analytic prediction (``~``
+    prefix), else the tracker's sampled allocator peak; budget appended
+    when one gated the run."""
+    if r.get("measured_peak_gb") is not None:
+        cell = _fmt(float(r["measured_peak_gb"]), ".2f")
+    elif r.get("compiled_gb") is not None:
         cell = _fmt(float(r["compiled_gb"]), ".2f")
     elif r.get("predicted_gb") is not None:
         cell = "~" + _fmt(float(r["predicted_gb"]), ".2f")
@@ -285,6 +304,11 @@ def render_table(rows: list[dict]) -> str:
             cc_cell += "⋈✓"
         elif r.get("ledger_ok") is False:
             cc_cell += "⋈✗"
+        # third mark: the memory ledger's measured-waterline verdict
+        if r.get("memory_ok") is True:
+            cc_cell += "▦✓"
+        elif r.get("memory_ok") is False:
+            cc_cell += "▦✗"
         comm = r.get("comm_fraction")
         ovl = r.get("overlap_fraction")
         out.append(
@@ -759,6 +783,83 @@ def render_bandwidth_regressions(results: list[dict]) -> str:
             f"| {key} "
             f"| {_fmt(r['busbw_gbps'], '.3f')} "
             f"| {_fmt(r['baseline_busbw_gbps'], '.3f')} "
+            f"| {r['delta_pct']:+.1f} "
+            f"| {'REGRESSED' if r['regressed'] else 'ok'} |")
+    return "\n".join(out)
+
+
+# ------------------------------------------------------------- memory
+
+def render_memory_table(rows: list[dict]) -> str:
+    """The measured-vs-predicted waterline side-by-side: every run that
+    filed a memory ledger (``memory.json`` + the MemoryVerdict), with the
+    measured allocator peak, its source tier (``allocator`` on real HBM,
+    ``accounted`` on the CPU sim where the backend exposes no stats),
+    the compiled ``memory_analysis()`` waterline, the driver's planner
+    prediction, and the biggest attributed categories."""
+    mrows = [r for r in rows if r.get("memory_verdict")]
+    if not mrows:
+        return "_no runs carry a memory ledger (profile-enabled runs " \
+               "with an attached step HLO write memory.json)_"
+    out = ["| run | measured GB | source | compiled GB | ratio | "
+           "predicted GB | pred source | top categories | verdict |",
+           "|---|---|---|---|---|---|---|---|---|"]
+    for r in sorted(mrows, key=lambda r: r.get("run_id") or ""):
+        v = r["memory_verdict"]
+        cats = {k[4:]: gb for k, gb in
+                (r.get("memory_aggregates") or {}).items()
+                if k.startswith("cat/")}
+        top = ", ".join(f"{k} {gb:.3f}" for k, gb in
+                        sorted(cats.items(), key=lambda kv: -kv[1])[:3])
+        out.append(
+            f"| {r.get('run_id', '—')} "
+            f"| {_fmt(v.get('measured_gb'), '.3f')} "
+            f"| {v.get('measured_source', '—')} "
+            f"| {_fmt(v.get('compiled_gb'), '.3f')} "
+            f"| {_fmt(v.get('compiled_ratio'), '.2f')} "
+            f"| {_fmt(v.get('predicted_gb'), '.3f')} "
+            f"| {v.get('predicted_source', '—')} "
+            f"| {top or '—'} "
+            f"| {'ok' if v.get('ok') else 'FAIL'} |")
+    return "\n".join(out)
+
+
+def check_memory_regressions(current: list[dict], baseline: list[dict],
+                             max_growth_pct: float = 20.0) -> list[dict]:
+    """Memory gate between comparable rows: for every (current, baseline)
+    pair :func:`_match` accepts where BOTH carry memory aggregates, diff
+    each shared key's GB via ``memledger.check_memory_regressions`` —
+    growth is the bad direction — the CI gate behind ``report.py
+    --fail-on-memory-regression``."""
+    from .memledger import check_memory_regressions as _diff
+    results = []
+    for cur in current:
+        for base in baseline:
+            if cur is base or not _match(cur, base):
+                continue
+            ca, ba = cur.get("memory_aggregates"), \
+                base.get("memory_aggregates")
+            if not ca or not ba:
+                continue
+            results += _diff(ca, ba, max_growth_pct=max_growth_pct,
+                             label=cur.get("run_id"),
+                             base_label=base.get("run_id")
+                             or base.get("strategy"))
+    return results
+
+
+def render_memory_regressions(results: list[dict]) -> str:
+    if not results:
+        return "_no comparable rows carry memory aggregates (both sides " \
+               "need a memory.json)_"
+    out = ["| run | baseline | key | GB | base GB | Δ % | verdict |",
+           "|---|---|---|---|---|---|---|"]
+    for r in results:
+        out.append(
+            f"| {r['run_id']} | {r['baseline']} "
+            f"| {r['key']} "
+            f"| {_fmt(r['gb'], '.4f')} "
+            f"| {_fmt(r['baseline_gb'], '.4f')} "
             f"| {r['delta_pct']:+.1f} "
             f"| {'REGRESSED' if r['regressed'] else 'ok'} |")
     return "\n".join(out)

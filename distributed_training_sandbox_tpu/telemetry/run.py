@@ -29,7 +29,13 @@ Glues the pieces together around the step loop:
     builds the :mod:`telemetry.ledger` CollectiveLedger from the owned
     trace — per-collective payloads and bus-GB/s in
     ``collectives.json``, with the measured contract verdict appended
-    to ``manifest.json`` beside the static one.
+    to ``manifest.json`` beside the static one;
+  * when :meth:`attach_step_hlo` also captured the compiled step's
+    ``memory_analysis()``, builds the :mod:`telemetry.memledger`
+    MemoryLedger — attributed categories + the phase-spanned allocator
+    timeline in ``memory.json``, with the MemoryVerdict stamped into
+    ``manifest.json`` as the third mark beside the contract and
+    collective-ledger verdicts.
 
 Usage (the shape every scripts/ entrypoint now follows)::
 
@@ -130,6 +136,16 @@ class TelemetryRun:
         # compiled HLO of the step program (attach_hlo), joined against
         # the owned trace at finalize to build the collective ledger
         self._hlo_text: str | None = None
+        # compiled-step memory accounting (attach_step_hlo): the
+        # memory_analysis() breakdown, eager tree-walk bytes per named
+        # arg category (computed BEFORE donation invalidates the
+        # buffers), per-path param attribution, and the driver's
+        # planner/serving prediction — joined at finalize into the
+        # memory ledger (memory.json)
+        self._memory_analysis: dict | None = None
+        self._mem_trees_bytes: dict | None = None
+        self._mem_param_paths: dict | None = None
+        self._mem_prediction: dict | None = None
 
     @staticmethod
     def _unique_run_id(results_dir: str, strategy: str,
@@ -176,6 +192,11 @@ class TelemetryRun:
             self.writer.write_manifest(self.manifest)
             from .spans import SpanStream
             self.spans = SpanStream(self.run_dir)
+            # phase-spanned allocator timeline: every host span the
+            # stream appends also samples the shared device-memory
+            # sampler under that span's phase (memledger.PHASES)
+            from .memledger import get_sampler
+            self.spans.sampler = get_sampler()
             if self._metrics_port is not None:
                 from .metrics import MetricsServer
                 self.metrics_server = MetricsServer(
@@ -192,7 +213,8 @@ class TelemetryRun:
         the text would otherwise double compile cost."""
         self._hlo_text = compiled_text
 
-    def attach_step_hlo(self, jitted, *args) -> None:
+    def attach_step_hlo(self, jitted, *args, trees=None,
+                        prediction=None) -> None:
         """Driver-facing form of :meth:`attach_hlo`: AOT-lower ``jitted``
         at ``args`` and attach the compiled text.  ``args`` MUST be the
         exact arrays the hot loop passes (same shapes, dtypes AND
@@ -200,16 +222,55 @@ class TelemetryRun:
         different program whose instruction names don't match the traced
         one, and the ledger join would report every site unmeasured.
         No-op unless this run owns an *enabled* profiler (no trace, no
-        join — don't pay the extra compile); never raises."""
+        join — don't pay the extra compile); never raises.
+
+        The same compile also feeds the memory ledger: its
+        ``memory_analysis()`` breakdown is captured, and ``trees`` — a
+        ``{category: pytree}`` dict of the named argument state
+        (defaulting to ``{params, opt_state, batch}`` from the first
+        three positional args, the universal train-step signature) — is
+        tree-walked into per-category bytes EAGERLY, because donation
+        invalidates these buffers the moment the hot loop runs.
+        ``prediction`` (a WaterlinePrediction-shaped dict, optional)
+        records the driver's analytic/serving waterline for the
+        measured-vs-predicted join at finalize."""
         prof = self.profiler
         if not self.enabled or self._hlo_text is not None \
                 or prof is None or not getattr(prof, "enabled", False):
             return
         try:
-            self.attach_hlo(jitted.lower(*args).compile().as_text())
+            compiled = jitted.lower(*args).compile()
+            self.attach_hlo(compiled.as_text())
         except Exception as e:   # best-effort: telemetry must not crash
             print(f"[telemetry] WARNING: could not attach compiled HLO "
                   f"for the collective ledger: {type(e).__name__}: {e}")
+            return
+        try:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                self._memory_analysis = {
+                    "argument_bytes": int(ma.argument_size_in_bytes),
+                    "output_bytes": int(ma.output_size_in_bytes),
+                    "temp_bytes": int(ma.temp_size_in_bytes),
+                    "alias_bytes": int(ma.alias_size_in_bytes),
+                }
+            if trees is None and len(args) >= 3:
+                trees = {"params": args[0], "opt_state": args[1],
+                         "batch": args[2]}
+            if trees:
+                from ..utils.memory import tree_size_bytes
+                from .memledger import param_path_bytes
+                self._mem_trees_bytes = {
+                    k: tree_size_bytes(v) for k, v in trees.items()}
+                if "params" in trees:
+                    self._mem_param_paths = param_path_bytes(
+                        trees["params"])
+            if prediction is not None:
+                self._mem_prediction = prediction.to_dict() \
+                    if hasattr(prediction, "to_dict") else dict(prediction)
+        except Exception as e:   # best-effort: telemetry must not crash
+            print(f"[telemetry] WARNING: could not attribute step memory "
+                  f"for the memory ledger: {type(e).__name__}: {e}")
 
     def __enter__(self) -> "TelemetryRun":
         return self.start()
@@ -405,11 +466,21 @@ class TelemetryRun:
                     ledger_verdict = None
             if ledger_verdict is not None:
                 summary["ledger"] = ledger_verdict
-            if self.manifest is not None and (owned or ledger_verdict):
+            mem_verdict = None
+            if self._memory_analysis is not None:
+                try:
+                    mem_verdict = self._build_memory()
+                except Exception:   # memory ledger must never fail the run
+                    mem_verdict = None
+            if mem_verdict is not None:
+                summary["memory"] = mem_verdict
+            if self.manifest is not None and (owned or ledger_verdict
+                                              or mem_verdict):
                 # the one sanctioned manifest rewrite (see
                 # telemetry.manifest): append the measured-side facts
                 self.manifest.profile_sessions = owned or None
                 self.manifest.ledger = ledger_verdict
+                self.manifest.memory = mem_verdict
                 self.writer.write_manifest(self.manifest)
         if self.spans is not None:
             self.spans.close()
@@ -430,6 +501,28 @@ class TelemetryRun:
         self.writer.write_summary(summary)
         self.writer.close()
         return summary
+
+    def _build_memory(self) -> dict | None:
+        """Build + file the memory ledger (``memory.json``); returns the
+        MemoryVerdict block stamped into summary/manifest beside the
+        contract and collective-ledger verdicts, or None when the attach
+        captured no ``memory_analysis()``."""
+        if self._memory_analysis is None:
+            return None
+        from .memledger import (MEMORY_FILENAME, build_memory_ledger,
+                                get_sampler, join_prediction)
+        capacity = None
+        cfg = self.manifest.config if self.manifest else {}
+        if isinstance(cfg, dict) and cfg.get("hbm_budget_gb"):
+            capacity = float(cfg["hbm_budget_gb"])
+        led = build_memory_ledger(
+            self._memory_analysis, self._mem_trees_bytes,
+            self._hlo_text or "", sampler=get_sampler(),
+            param_paths=self._mem_param_paths, capacity_gb=capacity)
+        verdict = join_prediction(led, self._mem_prediction,
+                                  strategy=self.strategy)
+        self.writer.write_json(MEMORY_FILENAME, led.to_dict())
+        return verdict
 
     def _build_ledger(self, session: str | None) -> dict | None:
         """Build + file the collective ledger; returns the compact

@@ -68,6 +68,10 @@ class SpanStream:
         self.rank = int(os.environ.get("DTS_PROCESS_ID", "0") or 0)
         self.pid = os.getpid()
         self._anchor_written = False
+        # optional memledger.MemorySampler: when wired (TelemetryRun.start
+        # does), every span append also folds one allocator read into the
+        # span's memory phase — the "phase-spanned" half of memory.json
+        self.sampler = None
         self._lock = threading.Lock()
         self._f = None
         self._unflushed = 0
@@ -122,6 +126,17 @@ class SpanStream:
     def _append(self, ev: dict) -> None:
         ev.setdefault("rank", self.rank)
         ev.setdefault("pid", self.pid)
+        if self.sampler is not None:
+            # outside the file lock: the sampler has its own, and a
+            # device round-trip under the append lock would serialize
+            # producer threads
+            from .memledger import phase_for_span
+            ph = phase_for_span(ev.get("name", ""), ev.get("cat"))
+            if ph:
+                try:
+                    self.sampler.sample(phase=ph)
+                except Exception:
+                    pass
         with self._lock:
             if self._closed:
                 return

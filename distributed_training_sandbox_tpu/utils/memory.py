@@ -110,15 +110,12 @@ def peak_memory_gb(device: jax.Device | None = None) -> float:
 
 def all_devices_memory_gb() -> dict[str, dict[str, float]]:
     """Per-device current/peak GB, twin of ``gpu_memory_usage_all``
-    (``fsdp/utils.py:204-219``)."""
-    out = {}
-    for d in jax.local_devices():
-        s = device_memory_stats(d)
-        out[str(d.id)] = {
-            "current_gb": s["bytes_in_use"] / GB,
-            "peak_gb": s["peak_bytes_in_use"] / GB,
-        }
-    return out
+    (``fsdp/utils.py:204-219``).  Delegates to the memory ledger's one
+    shared sampler (``telemetry.memledger.get_sampler``) so every
+    consumer polls the allocator through the same site.  Lazy import:
+    memledger imports this module."""
+    from ..telemetry.memledger import get_sampler
+    return get_sampler().all_devices_gb()
 
 
 def print_memory_stats(

@@ -19,7 +19,7 @@ import time
 
 import jax
 
-from .memory import all_devices_memory_gb, device_memory_stats, GB
+from .memory import all_devices_memory_gb, GB
 
 
 class PerformanceTracker:
@@ -74,7 +74,10 @@ class PerformanceTracker:
         self.loss_count += 1
 
     def _sample_memory(self) -> None:
-        peak = device_memory_stats()["peak_bytes_in_use"]
+        # one shared poll site for the whole process: the memory ledger's
+        # sampler folds this read into its dispatch-phase peak too
+        from ..telemetry.memledger import get_sampler
+        peak = get_sampler().sample(phase="dispatch")["peak_bytes_in_use"]
         if peak:
             self._peak_gb = peak / GB
             self._mem_all = all_devices_memory_gb()

@@ -198,7 +198,9 @@ def _leg(mode, args, rest, cfg, ctx, plan=None):
                     break
                 if i == ctx.start_step:
                     # ledger join: compiled text at the loop's exact
-                    # shardings (the staged batch, not a host copy)
+                    # shardings (the staged batch, not a host copy); the
+                    # memory ledger attributes the same compile's
+                    # memory_analysis() to (shards, opt_state, batch)
                     telem.attach_step_hlo(step, shards, opt_state, batch)
                 shards, opt_state, loss = step(shards, opt_state, batch)
                 log = (lambda lf, i=i:

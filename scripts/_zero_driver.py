@@ -65,7 +65,8 @@ def _time_steps(step_fn, state, batch, n_steps, telem=None, label="",
         ctx.ckptr.metrics = telem.metrics
     if telem is not None:
         # ledger join: compiled text at the loop's exact arg shardings
-        # (this driver reuses one fixed batch for every step)
+        # (this driver reuses one fixed batch for every step); the memory
+        # ledger attributes the same compile to (params, opt, batch)
         telem.attach_step_hlo(step_fn, params, opt, batch)
     t0 = None
     pump = StepPump(telem=telem,

@@ -196,7 +196,9 @@ def _mlp_leg(args, cfg, ctx):
                     break
                 if i == ctx.start_step:
                     # ledger join: compiled text at the loop's exact
-                    # shardings (the staged batch, not a host copy)
+                    # shardings (the staged batch, not a host copy); the
+                    # memory ledger attributes the same compile's
+                    # memory_analysis() to (params, opt_state, batch)
                     telem.attach_step_hlo(step, params, opt_state, batch)
                 params, opt_state, loss = step(params, opt_state, batch)
                 log = (lambda lf, i=i:
@@ -355,6 +357,8 @@ def _classification_leg(args, cfg, ctx):
                     sh = jbatch["input_ids"].sharding
                     assert getattr(sh, "spec", None) == P("dp"), \
                         f"batch not dp-sharded: {sh}"
+                    # ledger + memory-ledger join at the bucketed
+                    # loop's widest shape
                     telem.attach_step_hlo(step, params, opt_state, jbatch)
                 params, opt_state, loss = step(params, opt_state, jbatch)
                 width = jbatch["input_ids"].shape[1]

@@ -136,6 +136,51 @@ def check_ledger_run(run_dir: str) -> int:
     return 0
 
 
+def check_memory_run(run_dir: str) -> int:
+    """The ``--memory`` CI mode, mirror of :func:`check_ledger_run` for
+    the memory ledger: one run dir's MemoryVerdict
+    (``manifest.json:memory`` — measured allocator peak joined to the
+    compiled ``memory_analysis()`` waterline and, when the driver passed
+    one, the planner prediction) must be ok.  Exit 1 when measured
+    disagrees with predicted (out of band), 2 when inputs are missing."""
+    from distributed_training_sandbox_tpu.telemetry.memledger import (
+        load_memory_dict)
+
+    man_path = Path(run_dir) / "manifest.json"
+    try:
+        manifest = json.load(open(man_path))
+    except (OSError, json.JSONDecodeError):
+        print(f"[lint:memory] ERROR: cannot read {man_path}")
+        return 2
+    verdict = manifest.get("memory")
+    mem = load_memory_dict(run_dir)
+    if verdict is None or mem is None:
+        print(f"[lint:memory] ERROR: {run_dir} has no memory verdict "
+              f"and/or memory.json (run with --profile so the driver "
+              f"attaches the compiled step)")
+        return 2
+    ok = verdict.get("ok")
+    print(f"[lint:memory] {run_dir}: measured "
+          f"{verdict.get('measured_gb')} GB "
+          f"({verdict.get('measured_source')}) vs compiled "
+          f"{verdict.get('compiled_gb')} GB"
+          + (f", predicted {verdict['predicted_gb']} GB "
+             f"({verdict.get('predicted_source')})"
+             if "predicted_gb" in verdict else "")
+          + f" — ok={ok}")
+    for v in verdict.get("violations") or []:
+        print(f"[lint:memory]   violation: {v}")
+    if ok is None:
+        print("[lint:memory] ERROR: verdict carries no ok flag")
+        return 2
+    if not ok:
+        print("[lint:memory] FAIL: measured peak disagrees with the "
+              "prediction band")
+        return 1
+    print("[lint:memory] OK: measured peak within the prediction band")
+    return 0
+
+
 def check_contract_coverage(report: dict, *, strict: bool) -> None:
     """Registry ↔ contract cross-check: a strategy registered with
     ``fixtures.register_strategy`` but absent from ``CONTRACTS`` is an
@@ -192,10 +237,19 @@ def main(argv=None) -> int:
                         "its collectives.json; exit nonzero when they "
                         "disagree or the measured side failed (skips the "
                         "static analysis passes)")
+    p.add_argument("--memory", type=str, default=None, metavar="RUN_DIR",
+                   help="measured-vs-predicted memory cross-check of one "
+                        "telemetry run dir: the manifest's MemoryVerdict "
+                        "(allocator peak vs compiled memory_analysis() "
+                        "waterline vs planner prediction) must be ok; "
+                        "exit 1 on disagreement, 2 when inputs are "
+                        "missing (skips the static analysis passes)")
     args = p.parse_args(argv)
 
     if args.ledger:
         return check_ledger_run(args.ledger)
+    if args.memory:
+        return check_memory_run(args.memory)
 
     if args.cpu_devices:
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
@@ -247,6 +301,12 @@ def main(argv=None) -> int:
             if d.is_dir():
                 findings += lint_tree(d, recursive=True,
                                       checks={"span-name-not-static"})
+        # the whole package joins the allocator-poll sweep: a
+        # memory_stats()/device_memory_stats() read inside a *step* hot
+        # loop is a per-iteration host sync the shared sampler replaces
+        if pkg_dir.is_dir():
+            findings += lint_tree(pkg_dir, recursive=True,
+                                  checks={"mem-stats-in-hot-loop"})
         report["pitfalls"] = [f.to_dict() for f in findings]
         errors = [f for f in findings if f.severity == "error"]
         for f in findings:

@@ -24,6 +24,10 @@ Usage:
   python scripts/report.py runs --baseline base_runs \
       --fail-on-bandwidth-regression 20  # CI gate: per-collective busbw
                                          # may not drop more than 20 %
+  python scripts/report.py runs --baseline base_runs \
+      --fail-on-memory-regression 20   # CI gate: measured peak / any
+                                       # attributed category may not grow
+                                       # more than 20 %
 """
 
 from __future__ import annotations
@@ -67,6 +71,12 @@ def main(argv=None) -> int:
                         "(collective, payload, axis) aggregate's busbw "
                         "drops more than PCT %% below its baseline — "
                         "the collective-ledger CI gate")
+    p.add_argument("--fail-on-memory-regression", type=float,
+                   default=None, metavar="PCT",
+                   help="with --baseline: exit nonzero when a run's "
+                        "measured memory peak or any attributed category "
+                        "grows more than PCT %% over its baseline — "
+                        "the memory-ledger CI gate")
     p.add_argument("--nccl-baseline", default=None, metavar="JSON",
                    help="NCCL reference table for the side-by-side "
                         "(default: baselines/nccl_reference.json when "
@@ -117,6 +127,9 @@ def main(argv=None) -> int:
     if args.fail_on_bandwidth_regression is not None and not args.baseline:
         p.error("--fail-on-bandwidth-regression needs --baseline (the "
                 "run dir whose collectives.json to diff against)")
+    if args.fail_on_memory_regression is not None and not args.baseline:
+        p.error("--fail-on-memory-regression needs --baseline (the "
+                "run dir whose memory.json to diff against)")
 
     # reference tables for the NCCL-vs-ICI side-by-side: explicit paths
     # win; otherwise the checked-in baselines/ artifacts when present
@@ -130,7 +143,7 @@ def main(argv=None) -> int:
         cands = sorted(baselines_dir.glob("busbench_*.json"))
         roofline_rows = R.load_roofline(str(cands[-1])) if cands else []
 
-    comparisons, overlap_cmp, bw_cmp = [], [], []
+    comparisons, overlap_cmp, bw_cmp, mem_cmp = [], [], [], []
     if args.baseline:
         base_rows = R.load_baseline_rows(args.baseline)
         comparisons = R.check_regressions(rows, base_rows,
@@ -143,6 +156,10 @@ def main(argv=None) -> int:
             rows, base_rows,
             max_drop_pct=args.fail_on_bandwidth_regression
             if args.fail_on_bandwidth_regression is not None else 20.0)
+        mem_cmp = R.check_memory_regressions(
+            rows, base_rows,
+            max_growth_pct=args.fail_on_memory_regression
+            if args.fail_on_memory_regression is not None else 20.0)
     regressed = [c for c in comparisons if c["regressed"]]
     overlap_regressed = ([c for c in overlap_cmp if c["regressed"]]
                          if args.fail_on_overlap_regression is not None
@@ -150,11 +167,15 @@ def main(argv=None) -> int:
     bw_regressed = ([c for c in bw_cmp if c["regressed"]]
                     if args.fail_on_bandwidth_regression is not None
                     else [])
+    mem_regressed = ([c for c in mem_cmp if c["regressed"]]
+                     if args.fail_on_memory_regression is not None
+                     else [])
 
     if args.as_json:
         print(json.dumps({"runs": rows, "comparisons": comparisons,
                           "overlap_comparisons": overlap_cmp,
                           "bandwidth_comparisons": bw_cmp,
+                          "memory_comparisons": mem_cmp,
                           "chaos": [doc for doc, _ in chaos_docs],
                           "schema_problems": schema_problems}, indent=2,
                          default=str))
@@ -183,6 +204,10 @@ def main(argv=None) -> int:
                   "NCCL reference)\n")
             print(R.render_bandwidth_table(rows, nccl_rows,
                                            roofline_rows))
+        if any(r.get("memory_verdict") for r in rows):
+            print("\n## Memory ledger (measured vs predicted "
+                  "waterline)\n")
+            print(R.render_memory_table(rows))
         if args.steps:
             for rec in recs:
                 tail = R.load_steps(rec["dir"])[-5:]
@@ -212,12 +237,20 @@ def main(argv=None) -> int:
                 print(f"\nBANDWIDTH REGRESSIONS: {len(bw_regressed)} "
                       f"ledger aggregate(s) dropped more than "
                       f"{args.fail_on_bandwidth_regression:g} %")
+            if mem_cmp:
+                print(f"\n## Memory deltas vs {args.baseline}\n")
+                print(R.render_memory_regressions(mem_cmp))
+            if mem_regressed:
+                print(f"\nMEMORY REGRESSIONS: {len(mem_regressed)} "
+                      f"memory aggregate(s) grew more than "
+                      f"{args.fail_on_memory_regression:g} %")
         if schema_problems:
             print("\n## Schema violations\n")
             for prob in schema_problems:
                 print(f"* {prob}")
 
-    if regressed or schema_problems or overlap_regressed or bw_regressed:
+    if regressed or schema_problems or overlap_regressed \
+            or bw_regressed or mem_regressed:
         return 1
     return 0
 

@@ -12,6 +12,13 @@ database and answer the questions artifact-grepping can't —
     (collective kind, payload bucket, mesh axis) an autotuner can use
     as its communication cost table.  ``load_cost_model`` round-trips
     it back for consumers.
+  * ``export-memory-priors`` — fold memory-ledger verdicts across >= N
+    indexed runs into ``memory_priors.json``: the measured-over-
+    predicted waterline ratio (overall + per strategy) plus typical
+    per-category GB, which ``memory_plan.load_memory_priors`` feeds
+    back into ``analytic_waterline(priors=...)`` so measured residuals
+    recalibrate the analytic model the way bench priors anchor the
+    tuner.
   * ``chaos``   — tabulate chaos campaign cells (scripts/chaos.py);
     ``index`` picks up a ``chaos_report.json`` sitting in the results
     root (or passed explicitly) into the ``chaos_cells`` table.
@@ -23,6 +30,7 @@ artifacts, which remain the source of truth.
   python scripts/runs.py list
   python scripts/runs.py diff RUN_A RUN_B
   python scripts/runs.py export-cost-model --out cost_model.json
+  python scripts/runs.py export-memory-priors --out memory_priors.json
 """
 
 from __future__ import annotations
@@ -82,6 +90,12 @@ CREATE TABLE IF NOT EXISTS ledger_aggregates (
     algbw_gbps     REAL,
     busbw_gbps     REAL,
     PRIMARY KEY (run_id, kind, payload_bucket, axis)
+);
+CREATE TABLE IF NOT EXISTS memory_aggregates (
+    run_id TEXT NOT NULL,
+    key    TEXT NOT NULL,
+    gb     REAL,
+    PRIMARY KEY (run_id, key)
 );
 CREATE TABLE IF NOT EXISTS chaos_cells (
     report       TEXT NOT NULL,
@@ -153,6 +167,16 @@ def index_run_dir(conn: sqlite3.Connection, run_dir: str) -> str | None:
              agg.get("sites"), agg.get("events"), agg.get("total_us"),
              agg.get("bytes_moved"), agg.get("bus_bytes_moved"),
              agg.get("algbw_gbps"), agg.get("busbw_gbps")))
+    conn.execute("DELETE FROM memory_aggregates WHERE run_id = ?",
+                 (run_id,))
+    memdoc = _load_json(d / "memory.json")
+    if memdoc:
+        from distributed_training_sandbox_tpu.telemetry.memledger import (
+            memory_aggregates)
+        for key, gb in memory_aggregates(memdoc).items():
+            conn.execute(
+                "INSERT OR REPLACE INTO memory_aggregates VALUES (?,?,?)",
+                (run_id, key, gb))
     conn.commit()
     return run_id
 
@@ -242,8 +266,28 @@ def diff_runs(conn: sqlite3.Connection, run_a: str,
         busbw[key] = {"baseline_gbps": r["base"],
                       "current_gbps": r["cur"],
                       "delta_gbps": round(delta, 4)}
+    # per-category memory deltas where both runs filed a memory ledger;
+    # direction-aware: memory GROWTH is the regression
+    rows = conn.execute(
+        "SELECT a.key, a.gb AS base, b.gb AS cur "
+        "FROM memory_aggregates a JOIN memory_aggregates b "
+        "  ON a.key = b.key "
+        "WHERE a.run_id = ? AND b.run_id = ?", (run_a, run_b))
+    memory = {}
+    for r in rows:
+        base, cur = r["base"] or 0.0, r["cur"] or 0.0
+        delta = cur - base
+        pct = (delta / base * 100.0) if base else None
+        verdict = "flat"
+        if abs(delta) > 1e-9:
+            verdict = "regressed" if delta > 0 else "improved"
+        memory[r["key"]] = {"baseline_gb": base, "current_gb": cur,
+                            "delta_gb": round(delta, 6),
+                            "pct": round(pct, 3) if pct is not None
+                            else None,
+                            "verdict": verdict}
     return {"baseline": run_a, "current": run_b,
-            "metrics": metrics, "busbw": busbw}
+            "metrics": metrics, "busbw": busbw, "memory": memory}
 
 
 # ------------------------------------------------------------- cost model
@@ -343,6 +387,63 @@ def load_cost_model(path: str) -> CostModel:
         return CostModel(json.load(f))
 
 
+# ----------------------------------------------------------- memory priors
+
+def export_memory_priors(conn: sqlite3.Connection,
+                         run_ids: list[str] | None = None,
+                         min_runs: int = 3) -> dict:
+    """Fold memory-ledger verdicts across indexed runs into the
+    predictor's recalibration priors: the median measured-over-
+    predicted waterline ratio (overall + per strategy — this is the
+    scalar ``analytic_waterline(priors=...)`` multiplies by) and the
+    median attributed GB per category.  Requires >= ``min_runs``
+    distinct contributing runs so one outlier can't steer the model;
+    schema is gated on load by ``memory_plan.load_memory_priors``."""
+    import statistics
+
+    from distributed_training_sandbox_tpu.memory_plan import (
+        MEMORY_PRIORS_SCHEMA_VERSION)
+
+    where, params = "", []
+    if run_ids:
+        where = ("WHERE run_id IN (%s)" % ",".join("?" * len(run_ids)))
+        params = list(run_ids)
+    ratios: list[float] = []
+    by_strategy: dict[str, list[float]] = {}
+    contributing = []
+    for r in conn.execute(f"SELECT * FROM runs {where}", params):
+        verdict = (json.loads(r["summary_json"] or "{}")
+                   .get("memory") or {})
+        measured = verdict.get("measured_gb")
+        predicted = verdict.get("predicted_gb",
+                                verdict.get("compiled_gb"))
+        if not measured or not predicted:
+            continue
+        contributing.append(r["run_id"])
+        ratio = measured / predicted
+        ratios.append(ratio)
+        by_strategy.setdefault(r["strategy"] or "?", []).append(ratio)
+    if len(contributing) < min_runs:
+        raise ValueError(
+            f"memory priors need >= {min_runs} runs with a memory "
+            f"verdict; have {len(contributing)}: {sorted(contributing)}")
+    by_cat: dict[str, list[float]] = {}
+    for r in conn.execute(
+            f"SELECT * FROM memory_aggregates {where}", params):
+        if r["run_id"] in contributing and r["key"].startswith("cat/"):
+            by_cat.setdefault(r["key"][4:], []).append(r["gb"] or 0.0)
+    return {
+        "schema_version": MEMORY_PRIORS_SCHEMA_VERSION,
+        "runs": sorted(contributing),
+        "n_runs": len(contributing),
+        "overall_ratio": round(statistics.median(ratios), 4),
+        "by_strategy": {s: round(statistics.median(v), 4)
+                        for s, v in sorted(by_strategy.items())},
+        "by_category": {c: round(statistics.median(v), 6)
+                        for c, v in sorted(by_cat.items())},
+    }
+
+
 # -------------------------------------------------------------------- cli
 
 def _cmd_index(conn, args) -> int:
@@ -409,6 +510,13 @@ def _cmd_show(conn, args) -> int:
             print(f"    {a['kind']:22} {a['payload_bucket']:8} "
                   f"axis={a['axis']:10} busbw={a['busbw_gbps']} GB/s "
                   f"({a['events']} events, {a['total_us']:.0f} us)")
+    mems = conn.execute(
+        "SELECT * FROM memory_aggregates WHERE run_id = ? ORDER BY key",
+        (args.run_id,)).fetchall()
+    if mems:
+        print("  memory aggregates:")
+        for m in mems:
+            print(f"    {m['key']:28} {_fmt(m['gb'], 6):>12} GB")
     return 0
 
 
@@ -423,10 +531,16 @@ def _cmd_diff(conn, args) -> int:
         print(f"  busbw {key:34} {row['baseline_gbps']} -> "
               f"{row['current_gbps']} GB/s "
               f"({row['delta_gbps']:+.3f})")
+    for key, row in d["memory"].items():
+        pct = f" ({row['pct']:+.1f}%)" if row["pct"] is not None else ""
+        print(f"  mem   {key:34} {row['baseline_gb']} -> "
+              f"{row['current_gb']} GB [{row['verdict']}{pct}]")
     if args.json:
         print(json.dumps(d, indent=2))
     regressed = [m for m, row in d["metrics"].items()
                  if row["verdict"] == "regressed"]
+    regressed += [f"memory:{k}" for k, row in d["memory"].items()
+                  if row["verdict"] == "regressed"]
     return 1 if (args.fail_on_regression and regressed) else 0
 
 
@@ -477,6 +591,24 @@ def _cmd_export(conn, args) -> int:
     return 0
 
 
+def _cmd_export_memory(conn, args) -> int:
+    try:
+        priors = export_memory_priors(conn, args.run_ids or None,
+                                      min_runs=args.min_runs)
+    except ValueError as e:
+        print(f"[runs] {e}", file=sys.stderr)
+        return 2
+    with open(args.out, "w") as f:
+        json.dump(priors, f, indent=2)
+        f.write("\n")
+    print(f"[runs] memory priors from {priors['n_runs']} run(s): "
+          f"measured/predicted ratio {priors['overall_ratio']} "
+          f"-> {args.out}")
+    for s, v in priors["by_strategy"].items():
+        print(f"  {s:12} ratio={v}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="index + query telemetry run dirs")
@@ -524,13 +656,24 @@ def main(argv=None) -> int:
     s.add_argument("--min-runs", type=int, default=3,
                    help="minimum distinct contributing runs (default 3)")
 
+    s = sub.add_parser("export-memory-priors",
+                       help="fold memory-ledger verdicts across runs "
+                            "into the predictor's recalibration priors")
+    s.add_argument("run_ids", nargs="*",
+                   help="restrict to these runs (default: all indexed)")
+    s.add_argument("--out", type=str, default="memory_priors.json")
+    s.add_argument("--min-runs", type=int, default=3,
+                   help="minimum distinct contributing runs (default 3)")
+
     args = p.parse_args(argv)
     conn = connect(args.db)
     try:
         return {"index": _cmd_index, "list": _cmd_list,
                 "show": _cmd_show, "diff": _cmd_diff,
                 "chaos": _cmd_chaos,
-                "export-cost-model": _cmd_export}[args.cmd](conn, args)
+                "export-cost-model": _cmd_export,
+                "export-memory-priors": _cmd_export_memory,
+                }[args.cmd](conn, args)
     finally:
         conn.close()
 

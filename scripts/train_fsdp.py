@@ -281,8 +281,11 @@ def _leg(args, rest, cfg, ctx):
                     break
                 if i == ctx.start_step:
                     # ledger join: compiled text at the loop's exact
-                    # shardings (the staged batch, not a host copy)
-                    telem.attach_step_hlo(step, shards, opt_state, batch)
+                    # shardings (the staged batch, not a host copy); the
+                    # planner record rides along so the memory ledger can
+                    # verdict measured-vs-predicted
+                    telem.attach_step_hlo(step, shards, opt_state, batch,
+                                          prediction=mem_record)
                 shards, opt_state, loss = step(shards, opt_state, batch)
                 log = (lambda lf, i=i:
                        print(f"[fsdp] step {i:3d} loss {lf:.4f}")) \

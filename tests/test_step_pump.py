@@ -184,14 +184,17 @@ def test_writer_buffers_and_flushes_every_n(tmp_path):
 
 
 def test_tracker_samples_memory_every_n(monkeypatch):
+    from distributed_training_sandbox_tpu.telemetry import memledger as ML
     from distributed_training_sandbox_tpu.utils import tracker as tr
     calls = {"n": 0}
 
-    def fake_stats():
+    def fake_stats(*a):
         calls["n"] += 1
-        return {"peak_bytes_in_use": 1 << 30}
+        return {"bytes_in_use": 0, "peak_bytes_in_use": 1 << 30}
 
-    monkeypatch.setattr(tr, "device_memory_stats", fake_stats)
+    # the tracker polls through the memory ledger's shared sampler, so
+    # the fake goes on the sampler's poll site, not the tracker's
+    monkeypatch.setattr(ML, "device_memory_stats", fake_stats)
     monkeypatch.setattr(tr, "all_devices_memory_gb", lambda: {"cpu:0": 1.0})
     t = tr.PerformanceTracker(warmup_steps=0, memory_sample_every=5)
     for _ in range(10):
