@@ -8,6 +8,14 @@ machine-checked contract instead of a spot-checked print:
     strategy (expected collective site counts per step, allowed mesh
     axes, approximate payload bytes), checked against
     ``ops.hlo.count_collectives`` of the lowered step;
+  * ``rules``      — ordered ``(regex, PartitionSpec)`` partition rules
+    per strategy family (:class:`RuleSet`, the zero1/2/3 family folded
+    into a ``weight_update_sharding`` axis): the declarative source of
+    truth PartitionSpecs, contracts, and drift checks derive from, with
+    static rule hygiene (unmatched leaf / dead rule / shadowed rule);
+  * ``contract_gen`` — generate each strategy's CollectiveContract from
+    its RuleSet; :func:`diff_all_contracts` proves the generator against
+    the hand registry field-by-field;
   * ``hlo_lint``   — lint passes over *compiled* HLO text: accidental
     full-param replication (unexpected all-gather of a full param
     shape), missing input/output buffer aliasing where donation was
@@ -33,6 +41,26 @@ from .contracts import (  # noqa: F401
     check_counts,
     evaluate_contract,
 )
-from .hlo_lint import LintFinding, lint_compiled_hlo  # noqa: F401
+from .contract_gen import (  # noqa: F401
+    ContractDiff,
+    diff_all_contracts,
+    diff_contract,
+    generate_all_contracts,
+    generate_contract,
+)
+from .hlo_lint import (  # noqa: F401
+    LintFinding,
+    check_sharding_drift,
+    lint_compiled_hlo,
+)
+from .rules import (  # noqa: F401
+    MatchReport,
+    Rule,
+    RULESETS,
+    RuleSet,
+    expected_arg_specs,
+    match_partition_rules,
+    rules_manifest_verdict,
+)
 from .recompile import RecompileReport, watch_recompiles  # noqa: F401
 from .pitfalls import PitfallFinding, lint_file, lint_tree  # noqa: F401

@@ -1,6 +1,6 @@
 """Lint passes over compiled HLO text.
 
-Four checks, each catching one way a refactor silently breaks the
+Five checks, each catching one way a refactor silently breaks the
 sharding story without failing any numeric test:
 
   * **replication** — an ``all-gather`` whose output is a full-parameter
@@ -17,7 +17,12 @@ sharding story without failing any numeric test:
   * **foreign axis** — a collective whose replica groups match no
     declared mesh axis combination: the op spans devices the strategy
     never meant to couple (e.g. a psum leaking across ``tp`` in a
-    dp-only gradient sync).
+    dp-only gradient sync);
+  * **sharding drift** — a compiled entry parameter whose
+    ``sharding={...}`` annotation tiles a dimension differently than the
+    strategy's partition-rule-derived spec says it should: a driver that
+    silently diverged from its declared rules goes red statically
+    (:func:`check_sharding_drift`, fed by ``rules.expected_arg_specs``).
 
 All checks are pure text analysis over ``lowered.compile().as_text()``
 — nothing executes, so they run on the CPU backend in CI against the
@@ -32,7 +37,7 @@ import re
 from dataclasses import dataclass
 from itertools import combinations
 
-from ..ops.hlo import collective_instances
+from ..ops.hlo import collective_instances, entry_parameter_shardings
 
 SEV_ERROR = "error"
 SEV_WARN = "warn"
@@ -211,6 +216,67 @@ def check_replica_axes(instances, mesh, allowed_axes=None):
                 f"{inst.kind} replica groups match no mesh axis "
                 f"combination of {dict(mesh.shape)}: {inst.line[:160]}"))
     return findings
+
+
+def check_sharding_drift(text: str, expected, *, mesh=None,
+                         axis_sizes=None):
+    """Compare compiled entry-parameter ``sharding={...}`` annotations
+    against the rule-derived specs, by per-dimension tile factor.
+
+    ``expected``: the flatten-ordered :class:`rules.ExpectedLeafSpec`
+    list from ``rules.expected_arg_specs`` — entry ``parameter(i)``
+    order IS the jit arg flatten order, so the join is positional.
+    Leaves whose role the RuleSet doesn't cover (``spec is None``, e.g.
+    the serving KV pool) and parameters the compiler left unannotated
+    are skipped, not failed — the check is a drift detector, not a
+    completeness gate (the hygiene pass already guarantees every
+    rule-covered leaf has a spec).
+
+    Device *order* within a tile is deliberately not compared: the
+    replica-group/foreign-axis check owns grouping; this check owns
+    placement (which dims are cut, by how much).
+
+    Returns ``(findings, stats)`` where ``stats`` is the JSON-ready
+    verdict recorded by the CLI: checked/skipped counts + mismatches.
+    """
+    from .rules import spec_str, tile_dims
+    if axis_sizes is None:
+        axis_sizes = dict(mesh.shape) if mesh is not None else {}
+    params = entry_parameter_shardings(text)
+    findings = []
+    stats = {"ok": True, "checked": 0, "skipped": 0,
+             "entry_params": len(params), "expected_leaves": len(expected),
+             "mismatches": []}
+    if len(params) != len(expected):
+        msg = (f"compiled module has {len(params)} entry parameters but "
+               f"the step args flatten to {len(expected)} leaves — "
+               f"positional join impossible, drift check skipped "
+               f"(was the step lowered with dropped/extra args?)")
+        findings.append(LintFinding("sharding_drift", SEV_WARN, msg))
+        stats["skipped"] = len(expected)
+        return findings, stats
+    for leaf, param in zip(expected, params):
+        ndim = len(leaf.shape)
+        if leaf.spec is None or param.sharding is None or ndim == 0:
+            stats["skipped"] += 1
+            continue
+        got = param.sharding.tiles(ndim)
+        want = tile_dims(leaf.spec, ndim, axis_sizes)
+        stats["checked"] += 1
+        if got != want:
+            stats["ok"] = False
+            where = (f" (compiler op_name {param.op_name!r})"
+                     if param.op_name else "")
+            msg = (f"parameter({param.index}) {leaf.path} shape "
+                   f"{list(leaf.shape)}: compiled sharding "
+                   f"{param.sharding.raw!r} tiles dims as {list(got)}, "
+                   f"but the partition rules derive "
+                   f"{spec_str(leaf.spec)} = tiles {list(want)} on "
+                   f"{dict(axis_sizes)}{where} — the driver drifted "
+                   f"from its declared rules")
+            stats["mismatches"].append(msg)
+            findings.append(LintFinding("sharding_drift", SEV_ERROR, msg))
+    return findings, stats
 
 
 def lint_compiled_hlo(text: str, *, mesh=None, allowed_axes=None,

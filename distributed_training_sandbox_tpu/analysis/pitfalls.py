@@ -75,6 +75,15 @@ traces it), tuned so the current ``scripts/`` tree is clean at the
     from a provably closed set marks the call line — or the line above
     — with ``# span-ok``.
 
+  * ``hand-rolled-partition-spec`` (error) — a non-trivial
+    ``PartitionSpec``/``P``/``PS`` literal inside a ``*step*`` function
+    of a module whose strategies are covered by a partition RuleSet
+    (``rules.RULE_COVERED_MODULE_STEMS``): placement there is supposed
+    to *derive* from the rules — a hand-rolled literal is exactly the
+    drift the ``--rules`` lint exists to catch, one refactor earlier.
+    The step makers' own in/out specs (the seam where rules become
+    shardings) mark the line — or the line above — with ``# spec-ok``.
+
   * ``mem-stats-in-hot-loop`` (warn) — ``memory_stats()`` /
     ``device_memory_stats()`` inside a Python loop of a ``*step*``
     function: the allocator query is a host round-trip, so polling it
@@ -190,6 +199,7 @@ class _Visitor(ast.NodeVisitor):
         self.dynamic_emit_names: list[tuple[int, str]] = []
         self.pallas_no_interpret: list[tuple[int, str]] = []
         self.mem_stats_in_loop: list[tuple[int, str]] = []
+        self.spec_literals: list[tuple[int, str]] = []
 
     # -- context tracking -------------------------------------------------
     def _visit_function(self, node):
@@ -280,6 +290,18 @@ class _Visitor(ast.NodeVisitor):
             self.ckpt_opens.append((node.lineno, chain))
         if leaf in CKPT_GUARDS:
             self.has_ckpt_guard = True
+        if (leaf == "PartitionSpec"
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id in ("P", "PS"))):
+            # a spec literal that actually partitions something (any
+            # non-None entry) inside a *step* function — replicated P()
+            # and the None placeholders are not placement decisions
+            nontrivial = bool(node.keywords) or any(
+                not (isinstance(a, ast.Constant) and a.value is None)
+                for a in node.args)
+            if nontrivial and any("step" in n.lower()
+                                  for n in self._fn_stack):
+                self.spec_literals.append((node.lineno, chain or leaf))
         if (leaf in MEM_STATS_FNS and self._loop_depth
                 and not self._jit_depth
                 and any("step" in n.lower() for n in self._fn_stack)):
@@ -460,6 +482,19 @@ def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
             f"cannot run it; plumb an interpret knob through the "
             f"wrapper (default jax.default_backend() != 'tpu'), or "
             f"mark a deliberate compile-only site with '# pallas-ok'"))
+    from .rules import RULE_COVERED_MODULE_STEMS
+    if Path(path).stem in RULE_COVERED_MODULE_STEMS:
+        for line, chain in v.spec_literals:
+            if _pragma(line, "spec-ok"):
+                continue
+            findings.append(PitfallFinding(
+                path, line, "hand-rolled-partition-spec", SEV_ERROR,
+                f"{chain}(...) literal inside a *step* function of a "
+                f"rule-covered module — placement here must derive from "
+                f"the strategy's RuleSet (analysis.rules), not a "
+                f"hand-rolled spec the --rules drift lint can't see "
+                f"coming; derive it, or mark the step maker's "
+                f"rules-derived seam with '# spec-ok'"))
     for line, chain in v.mem_stats_in_loop:
         if _pragma(line, "mem-ok"):
             continue

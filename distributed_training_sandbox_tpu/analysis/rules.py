@@ -1,0 +1,620 @@
+"""Rule-based sharding: ordered regex partition rules as source of truth.
+
+The 20 entries of ``fixtures.STRATEGIES`` were grown as hand-registered
+vertical strategies, each with a hand-calibrated
+:class:`~.contracts.CollectiveContract`.  This module owns the *static*
+half of the composable-core refactor (ROADMAP item 1): every strategy is
+described by a :class:`RuleSet` — ordered ``(regex, spec)`` partition
+rules over flattened named param paths on an arbitrary
+``dp x fsdp x tp x sp x ep x pp`` mesh — from which PartitionSpecs
+(params, opt-state, batch), expected collective choreographies
+(:mod:`.contract_gen`) and compiled-sharding lint checks
+(:func:`.hlo_lint.check_sharding_drift`) are all *derived*.
+
+The ZeRO family is folded into a single ``weight_update_sharding``
+config axis per "Automatic Cross-Replica Sharding of Weight Update"
+(arXiv:2004.13336): W0 replicates the update (ddp), W1 shards optimizer
+state (zero1), W2 also shards gradient reduction (zero2), W3 shards the
+weights themselves at rest (zero3/fsdp) — one constructor, not four
+modules' worth of contract formulas.
+
+Rule matching is first-match-wins over ``/``-joined leaf paths (the
+``match_partition_rules`` idiom of SNIPPETS.md [2]); scalars are never
+partitioned.  Static rule hygiene is part of the analysis:
+
+  * an **unmatched leaf** is an error (a param nobody placed);
+  * a rule that **never matches** any leaf is a dead-rule warning;
+  * an earlier rule that **fully shadows** a later one (the later rule
+    hits leaves, but every hit was already claimed) is an error;
+  * :meth:`MatchReport.describe` dumps which rule claimed each leaf.
+
+Everything here is importable without jax — jax is touched only inside
+the functions that walk real pytrees, so the AST lint
+(:mod:`.pitfalls`) and the CLI can load the registry cheaply.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+# A spec is a tuple of per-dimension entries: None (unsharded), one mesh
+# axis name, or a tuple of axis names (e.g. ("dp", "ep") batch sharding).
+Spec = tuple
+
+# The canonical mesh axis vocabulary rules may reference.
+MESH_AXES = ("dp", "fsdp", "tp", "sp", "ep", "pp")
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One ordered partition rule: leaves whose ``/``-joined path matches
+    ``pattern`` (``re.search``) take ``spec``, first match wins."""
+    pattern: str
+    spec: Spec
+    note: str = ""
+
+    def matches(self, path: str) -> bool:
+        return re.search(self.pattern, path) is not None
+
+
+def spec_axes(spec: Spec) -> set:
+    """Every mesh axis a spec references."""
+    out = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.update(entry)
+        else:
+            out.add(entry)
+    return out
+
+
+def to_partition_spec(spec: Spec):
+    """Spec tuple -> ``jax.sharding.PartitionSpec``."""
+    from jax.sharding import PartitionSpec as P  # spec-ok: the converter
+    return P(*spec)
+
+
+def tile_dims(spec: Spec, ndim: int, axis_sizes: Mapping[str, int]
+              ) -> tuple:
+    """Expected tile factor per array dimension under ``spec`` on a mesh
+    with ``axis_sizes`` — the quantity compiled ``sharding={...}``
+    annotations carry (``ops.hlo.ShardingAnnotation.tiles``)."""
+    tiles = []
+    for d in range(ndim):
+        entry = spec[d] if d < len(spec) else None
+        if entry is None:
+            tiles.append(1)
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        tiles.append(int(math.prod(int(axis_sizes.get(a, 1))
+                                   for a in axes)))
+    return tuple(tiles)
+
+
+def spec_str(spec: Spec) -> str:
+    """Human form of a spec tuple: ``P('dp', None)``."""
+    inner = ", ".join(
+        "None" if e is None
+        else ("(" + ",".join(repr(a) for a in e) + ")"
+              if isinstance(e, (tuple, list)) else repr(e))
+        for e in spec)
+    return f"P({inner})"
+
+
+# ---------------------------------------------------------------- paths
+
+def _key_name(key) -> str:
+    """One pytree path key -> a path segment."""
+    for attr in ("key", "idx", "name"):
+        v = getattr(key, attr, None)
+        if v is not None:
+            return str(v)
+    return str(key).strip(".[]'\"")
+
+
+def path_str(path) -> str:
+    """A jax keypath -> the ``/``-joined form rules match against
+    (``layers/wq``, ``mu/0/w``)."""
+    return "/".join(_key_name(k) for k in path)
+
+
+def named_leaf_paths(tree) -> list:
+    """Flatten a pytree to ``[(path_str, leaf), ...]`` in flatten order —
+    the named universe the rule engine matches over."""
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(path_str(p), leaf) for p, leaf in flat]
+
+
+def _leaf_shape(leaf) -> tuple:
+    return tuple(getattr(leaf, "shape", ()) or ())
+
+
+def _is_scalar(leaf) -> bool:
+    shape = _leaf_shape(leaf)
+    return len(shape) == 0 or math.prod(shape) <= 1
+
+
+# ---------------------------------------------------------------- matching
+
+@dataclass(frozen=True)
+class MatchedLeaf:
+    path: str
+    shape: tuple
+    spec: Spec
+    rule_index: int          # -1 = the scalar default (never partitioned)
+
+
+@dataclass
+class MatchReport:
+    """Outcome of matching one role's tree against one rule list, with
+    the static hygiene verdicts folded in."""
+    strategy: str
+    role: str                              # "params" | "opt" | "batch"
+    matches: list = field(default_factory=list)
+    errors: list = field(default_factory=list)
+    warnings: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def spec_by_path(self) -> dict:
+        return {m.path: m.spec for m in self.matches}
+
+    def describe(self) -> str:
+        """The rule-attribution dump: which rule claimed each leaf."""
+        lines = [f"[{self.strategy}:{self.role}]"]
+        for m in self.matches:
+            claim = ("scalar default" if m.rule_index < 0
+                     else f"rule #{m.rule_index}")
+            lines.append(f"  {m.path:40s} {spec_str(m.spec):24s}"
+                         f" <- {claim}")
+        for w in self.warnings:
+            lines.append(f"  warn: {w}")
+        for e in self.errors:
+            lines.append(f"  ERROR: {e}")
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        return {"strategy": self.strategy, "role": self.role,
+                "ok": self.ok,
+                "leaves": {m.path: spec_str(m.spec)
+                           for m in self.matches},
+                "errors": list(self.errors),
+                "warnings": list(self.warnings)}
+
+
+def match_partition_rules(rules, named_leaves, *, strategy: str = "",
+                          role: str = "params") -> MatchReport:
+    """First-match-wins rule application over ``(path, leaf)`` pairs,
+    with the three hygiene checks.  ``named_leaves`` is the output of
+    :func:`named_leaf_paths` (leaves may be arrays or ShapeDtypeStructs —
+    only ``.shape`` is read, nothing executes)."""
+    rules = tuple(rules)
+    report = MatchReport(strategy=strategy, role=role)
+    hits = [[] for _ in rules]       # leaves each rule's regex matches
+    claims = [[] for _ in rules]     # leaves each rule actually claimed
+    nonscalar = 0
+    for path, leaf in named_leaves:
+        if _is_scalar(leaf):
+            report.matches.append(
+                MatchedLeaf(path, _leaf_shape(leaf), (), -1))
+            continue
+        nonscalar += 1
+        claimed = None
+        for i, rule in enumerate(rules):
+            if rule.matches(path):
+                hits[i].append(path)
+                if claimed is None:
+                    claimed = i
+                    claims[i].append(path)
+        if claimed is None:
+            report.errors.append(
+                f"unmatched leaf {path!r} (shape "
+                f"{list(_leaf_shape(leaf))}): no partition rule places "
+                f"it — every non-scalar leaf must be claimed")
+        else:
+            report.matches.append(MatchedLeaf(
+                path, _leaf_shape(leaf), rules[claimed].spec, claimed))
+    # hygiene over the rule list itself — only meaningful when the tree
+    # actually has leaves to claim (an empty/scalar-only tree tells us
+    # nothing about the rules)
+    if nonscalar:
+        for i, rule in enumerate(rules):
+            if not hits[i]:
+                report.warnings.append(
+                    f"dead rule #{i} /{rule.pattern}/ -> "
+                    f"{spec_str(rule.spec)}: matches no leaf")
+            elif not claims[i]:
+                shadowers = sorted({
+                    j for j in range(i)
+                    for p in hits[i] if rules[j].matches(p)
+                    and p in claims[j]})
+                report.errors.append(
+                    f"shadowed rule #{i} /{rule.pattern}/ -> "
+                    f"{spec_str(rule.spec)}: every leaf it matches "
+                    f"({', '.join(hits[i][:4])}"
+                    f"{'…' if len(hits[i]) > 4 else ''}) was already "
+                    f"claimed by earlier rule(s) "
+                    f"{', '.join(f'#{j} /{rules[j].pattern}/' for j in shadowers)}"
+                    f" — reorder or delete it")
+    return report
+
+
+# ---------------------------------------------------------------- rule sets
+
+def mirror_opt_rules(param_rules) -> tuple:
+    """Optimizer-state rules derived from param rules: Adam moments
+    mirror the param leaf's placement (the ``mu/``/``nu/`` subtree paths
+    are the param paths one level down); scalars (count) fall to the
+    scalar default."""
+    out = []
+    for r in param_rules:
+        body = r.pattern.lstrip("^")
+        if body in (r".*", r".+"):
+            mp = r"^(mu|nu|momentum)(/|$)"
+        else:
+            mp = r"^(mu|nu|momentum)/" + body
+        out.append(Rule(mp, r.spec, note=f"mirrors param rule "
+                                         f"/{r.pattern}/"))
+    return tuple(out)
+
+
+@dataclass(frozen=True)
+class RuleSet:
+    """The declarative source of truth for one strategy family member:
+    partition rules per role plus the config knobs contract generation
+    keys on.  ``weight_update_sharding`` is the W-axis of
+    arXiv:2004.13336: 0 = replicated update (ddp), 1 = sharded opt
+    state (zero1), 2 = + sharded grad reduction (zero2), 3 = sharded
+    weights at rest (zero3 / fsdp)."""
+    strategy: str
+    family: str              # "data" | "fsdp" | "tp" | "sp" | "moe"
+    #                          | "serve" | "pipeline"
+    axes: tuple              # mesh axes the strategy's collectives span
+    param_rules: tuple
+    opt_rules: tuple = ()
+    batch_rules: tuple = ()
+    weight_update_sharding: int = 0
+    config: Mapping = field(default_factory=dict)
+    description: str = ""
+
+    @property
+    def arg_roles(self) -> dict:
+        """Step-arg position -> role, per the fixture calling
+        conventions (``fixtures.StrategyBuild.args``)."""
+        if self.family == "serve":
+            return {1: "params"}
+        if self.family == "pipeline":
+            return {0: "params"}
+        return {0: "params", 1: "opt", 2: "batch"}
+
+    def rules_for(self, role: str) -> tuple:
+        return {"params": self.param_rules, "opt": self.opt_rules,
+                "batch": self.batch_rules}[role]
+
+    def match(self, role: str, tree) -> MatchReport:
+        return match_partition_rules(
+            self.rules_for(role), named_leaf_paths(tree),
+            strategy=self.strategy, role=role)
+
+    def partition_specs(self, tree, role: str = "params"):
+        """The derived PartitionSpec pytree for ``tree`` (raises on any
+        hygiene error — an unmatched leaf must not silently replicate)."""
+        import jax
+        report = self.match(role, tree)
+        if not report.ok:
+            raise ValueError(
+                f"{self.strategy}:{role} rule hygiene failed:\n"
+                + "\n".join(report.errors))
+        by_path = report.spec_by_path()
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        specs = [to_partition_spec(by_path[path_str(p)]) for p, _ in flat]
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def describe(self, trees: Mapping[str, Any]) -> str:
+        """Rule-attribution dump over ``{role: tree}``."""
+        return "\n".join(self.match(role, tree).describe()
+                         for role, tree in trees.items())
+
+
+# -- constructors: one per family, the zero variants one config axis ----
+
+def data_parallel_ruleset(strategy: str, *,
+                          weight_update_sharding: int = 0,
+                          grad_comm: str = "allreduce",
+                          axis: str = "dp") -> RuleSet:
+    """The toy-MLP data-parallel family.  ``weight_update_sharding``
+    folds ddp (W0) and zero1/2/3 (W1/W2/W3) into one axis;
+    ``grad_comm`` picks the W0 gradient wire format (per-leaf
+    all-reduce, flat ~MB buckets, or int8-quantized buckets)."""
+    w = weight_update_sharding
+    if w >= 3:
+        param_rules = (Rule(r".*", (axis,),
+                            "W3: params at rest are flat owner chunks"),)
+    else:
+        param_rules = (Rule(r".*", (), "params replicated at rest"),)
+    if w >= 1:
+        opt_rules = (Rule(r"^(mu|nu|momentum)(/|$)", (axis,),
+                          "owner-chunk optimizer moments (ZeRO)"),)
+    else:
+        opt_rules = mirror_opt_rules(param_rules)
+    return RuleSet(
+        strategy=strategy, family="data", axes=(axis,),
+        param_rules=param_rules, opt_rules=opt_rules,
+        batch_rules=(Rule(r".*", (axis,)),),
+        weight_update_sharding=w,
+        config={"grad_comm": grad_comm},
+        description=f"data-parallel, weight_update_sharding=W{w}, "
+                    f"grad_comm={grad_comm}")
+
+
+def fsdp_ruleset(strategy: str, *, axis: str = "dp",
+                 overlap: str = "none", offload: str | None = None,
+                 precision: str | None = None) -> RuleSet:
+    """FSDP = W3 over named leaf dims instead of flat chunks: stacked
+    ``(L, ...)`` layer leaves shard dim 1 (dim 0 is the scan axis),
+    plain leaves shard dim 0.  ``overlap``/``offload``/``precision``
+    change wire or memory choreography, never placement."""
+    param_rules = (
+        Rule(r"^layers/", (None, axis),
+             "stacked (L, ...) layer leaves: shard dim 1"),
+        Rule(r".*", (axis,), "plain leaves (embed, final_norm): dim 0"),
+    )
+    return RuleSet(
+        strategy=strategy, family="fsdp", axes=(axis,),
+        param_rules=param_rules,
+        opt_rules=mirror_opt_rules(param_rules),
+        batch_rules=(Rule(r".*", (axis,)),),
+        weight_update_sharding=3,
+        config={"overlap": overlap, "offload": offload,
+                "precision": precision},
+        description=f"fsdp (W3 by named dim), overlap={overlap}"
+                    + (f", offload={offload}" if offload else "")
+                    + (f", precision={precision}" if precision else ""))
+
+
+# Megatron column/row role split of the dense transformer projections.
+TP_COL_LEAVES = ("wq", "wk", "wv", "w_gate", "w_up")
+TP_ROW_LEAVES = ("wo", "w_down")
+
+
+def tp_ruleset(strategy: str, *, axis: str = "tp", dp_axis: str = "dp",
+               overlap: str = "none") -> RuleSet:
+    """Megatron TP over stacked dense layers: column-parallel leaves
+    ``(L, in, out)`` shard the out dim, row-parallel the in dim, the
+    rest (embed, norms, router) replicated."""
+    col = "|".join(TP_COL_LEAVES)
+    row = "|".join(TP_ROW_LEAVES)
+    param_rules = (
+        Rule(rf"^layers/({col})$", (None, None, axis),
+             "column-parallel projections: shard the out dim"),
+        Rule(rf"^layers/({row})$", (None, axis, None),
+             "row-parallel projections: shard the in dim"),
+        Rule(r".*", (), "embed/norms replicated"),
+    )
+    return RuleSet(
+        strategy=strategy, family="tp", axes=(dp_axis, axis),
+        param_rules=param_rules,
+        opt_rules=mirror_opt_rules(param_rules),
+        batch_rules=(Rule(r".*", (dp_axis,)),),
+        weight_update_sharding=0,
+        config={"overlap": overlap},
+        description=f"megatron tp, overlap={overlap}")
+
+
+def sp_ruleset(strategy: str, *, axis: str = "sp",
+               dp_axis: str = "dp") -> RuleSet:
+    """FSDP placement over dp + ring attention over sp: params/opt are
+    exactly the fsdp rules; the batch also splits its sequence dim."""
+    base = fsdp_ruleset(strategy, axis=dp_axis)
+    return RuleSet(
+        strategy=strategy, family="sp", axes=(dp_axis, axis),
+        param_rules=base.param_rules, opt_rules=base.opt_rules,
+        batch_rules=(Rule(r".*", (dp_axis, axis),
+                          "batch split on both dp and sequence"),),
+        weight_update_sharding=3,
+        config={"sp_axis": axis},
+        description="fsdp over dp + ring attention over sp")
+
+
+def moe_ruleset(strategy: str, *, axis: str = "ep",
+                dp_axis: str = "dp") -> RuleSet:
+    """Switch-MoE: expert-stacked ``(L, E, ...)`` FFN leaves shard the
+    expert dim; router and every dense leaf replicated; the batch rides
+    the flattened (dp, ep) data axis."""
+    param_rules = (
+        Rule(r"^layers/(w_gate|w_up|w_down)$", (None, axis),
+             "expert-stacked (L, E, ...) FFN leaves: shard dim 1 (E)"),
+        Rule(r".*", (), "router + dense leaves replicated"),
+    )
+    return RuleSet(
+        strategy=strategy, family="moe", axes=(dp_axis, axis),
+        param_rules=param_rules,
+        opt_rules=mirror_opt_rules(param_rules),
+        batch_rules=(Rule(r".*", ((dp_axis, axis),),
+                          "batch over the flattened (dp, ep) axis"),),
+        weight_update_sharding=0,
+        config={},
+        description="switch-moe, experts sharded over ep")
+
+
+def serve_ruleset(strategy: str, *, axis: str = "tp",
+                  paged_kernel: bool = False) -> RuleSet:
+    """Serving decode: tp-sharded weights at rest, inference only (no
+    opt state; the KV pool and request vectors ride their own specs
+    outside the rule universe)."""
+    base = tp_ruleset(strategy, axis=axis)
+    return RuleSet(
+        strategy=strategy, family="serve", axes=(axis,),
+        param_rules=base.param_rules,
+        weight_update_sharding=0,
+        config={"paged_kernel": paged_kernel},
+        description="serving decode over tp"
+                    + (", paged-attention kernel" if paged_kernel else ""))
+
+
+def pipeline_ruleset(strategy: str, *, schedule: str | None = None
+                     ) -> RuleSet:
+    """Pipeline stages are single-device jitted programs: everything
+    replicated (within a stage), no mesh collectives at all."""
+    return RuleSet(
+        strategy=strategy, family="pipeline", axes=(),
+        param_rules=(Rule(r".*", (), "stage-local, no mesh"),),
+        weight_update_sharding=0,
+        config={"schedule": schedule or strategy},
+        description="pipeline stage programs (host-mediated transfers)")
+
+
+RULESETS: dict[str, RuleSet] = {
+    "ddp": data_parallel_ruleset("ddp", weight_update_sharding=0),
+    "ddp_bucketed": data_parallel_ruleset(
+        "ddp_bucketed", weight_update_sharding=0, grad_comm="bucketed"),
+    "ddp_q8": data_parallel_ruleset(
+        "ddp_q8", weight_update_sharding=0, grad_comm="q8"),
+    "zero1": data_parallel_ruleset("zero1", weight_update_sharding=1),
+    "zero2": data_parallel_ruleset("zero2", weight_update_sharding=2),
+    "zero3": data_parallel_ruleset("zero3", weight_update_sharding=3),
+    "fsdp": fsdp_ruleset("fsdp"),
+    "fsdp_offload": fsdp_ruleset("fsdp_offload", offload="opt"),
+    "fsdp_fp8": fsdp_ruleset("fsdp_fp8", precision="fp8"),
+    "fsdp_ring_fused_pallas": fsdp_ruleset(
+        "fsdp_ring_fused_pallas", overlap="ring_fused_pallas"),
+    "fsdp_ring": fsdp_ruleset("fsdp_ring", overlap="ring"),
+    "tp_ring": tp_ruleset("tp_ring", overlap="ring"),
+    "tp_q8": tp_ruleset("tp_q8", overlap="q8"),
+    "tp": tp_ruleset("tp"),
+    "sp": sp_ruleset("sp"),
+    "moe": moe_ruleset("moe"),
+    "serve_decode": serve_ruleset("serve_decode"),
+    "serve_decode_paged_kernel": serve_ruleset(
+        "serve_decode_paged_kernel", paged_kernel=True),
+    "gpipe": pipeline_ruleset("gpipe"),
+    "1f1b": pipeline_ruleset("1f1b"),
+}
+
+
+def ruleset_coverage() -> tuple:
+    """RULESETS <-> contract-registry cross-check, the rules twin of
+    ``fixtures.contract_coverage``: returns ``(missing, orphans)`` —
+    contracted strategies with no RuleSet (analyzer blind spot, error)
+    and RuleSets naming no contract (dead rules, error under the
+    default-strict gate)."""
+    from .contracts import CONTRACTS
+    missing = [s for s in CONTRACTS if s not in RULESETS]
+    orphans = [s for s in RULESETS if s not in CONTRACTS]
+    return missing, orphans
+
+
+# Module stems (parallel/ + scripts/ drivers + serving) whose step
+# functions are covered by a RuleSet — the pitfalls spec-literal lint
+# fires only inside these (a hand-rolled PartitionSpec there should be
+# derived from the rules instead, or carry a `# spec-ok` pragma).
+RULE_COVERED_MODULE_STEMS = frozenset({
+    # parallel/ family modules
+    "ddp", "zero", "fsdp", "tensor", "sequence", "expert",
+    # scripts/ drivers of contracted strategies
+    "zero1", "zero2", "zero3", "_zero_driver", "train_fsdp",
+    "train_tp", "train_sp", "train_moe", "_2d_driver",
+    # serving decode step builder
+    "engine",
+})
+
+
+# ---------------------------------------------------------------- verdicts
+
+@dataclass(frozen=True)
+class ExpectedLeafSpec:
+    """One flat step-arg leaf with its rule-derived spec (``spec`` is
+    None for roles outside the rule universe, e.g. the serve KV pool)."""
+    flat_index: int
+    role: str | None
+    path: str
+    shape: tuple
+    spec: Spec | None
+
+
+def expected_arg_specs(ruleset: RuleSet, args) -> tuple:
+    """Flatten a step's example args and attach the rule-derived spec to
+    every leaf of a rule-covered role.  Returns ``(expected, reports)``:
+    ``expected`` is aligned with the jit flatten order — which is also
+    the compiled module's entry ``parameter(i)`` order — and ``reports``
+    are the per-role hygiene MatchReports."""
+    import jax
+    expected: list[ExpectedLeafSpec] = []
+    reports: list[MatchReport] = []
+    roles = ruleset.arg_roles
+    flat_index = 0
+    for argnum, arg in enumerate(args):
+        role = roles.get(argnum)
+        by_path: dict | None = None
+        if role is not None:
+            report = ruleset.match(role, arg)
+            reports.append(report)
+            by_path = report.spec_by_path() if report.ok else {}
+        flat, _ = jax.tree_util.tree_flatten_with_path(arg)
+        for p, leaf in flat:
+            path = path_str(p)
+            spec = by_path.get(path) if by_path is not None else None
+            expected.append(ExpectedLeafSpec(
+                flat_index=flat_index,
+                role=role,
+                path=(f"{role or f'arg{argnum}'}/{path}" if path
+                      else (role or f"arg{argnum}")),
+                shape=_leaf_shape(leaf),
+                spec=spec))
+            flat_index += 1
+    return expected, reports
+
+
+def rules_manifest_verdict(strategy: str, *, params=None, opt=None,
+                           batch=None) -> dict:
+    """The cheap driver-side verdict recorded in ``manifest.json``
+    beside the static contract mark: rule hygiene over the live trees
+    plus a comparison of each committed leaf's ``NamedSharding`` spec
+    against its rule-derived spec.  No lowering, no compile — the
+    compiled-HLO drift lint is ``scripts/lint_sharding.py --rules``'s
+    job."""
+    rs = RULESETS.get(strategy)
+    if rs is None:
+        return {"strategy": strategy, "ok": False,
+                "error": f"no RuleSet registered for {strategy!r}"}
+    verdict: dict = {"strategy": strategy, "ok": True, "checked": 0,
+                     "mismatches": [], "hygiene": []}
+    for role, tree in (("params", params), ("opt", opt),
+                       ("batch", batch)):
+        if tree is None:
+            continue
+        report = rs.match(role, tree)
+        verdict["hygiene"].append(report.to_dict())
+        if not report.ok:
+            verdict["ok"] = False
+            continue
+        by_path = report.spec_by_path()
+        for path, leaf in named_leaf_paths(tree):
+            sharding = getattr(leaf, "sharding", None)
+            spec = getattr(sharding, "spec", None)
+            if spec is None:
+                continue
+            want = by_path.get(path)
+            if want is None:
+                continue
+            ndim = len(_leaf_shape(leaf))
+            axis_sizes = dict(getattr(sharding, "mesh").shape) \
+                if getattr(sharding, "mesh", None) is not None else {}
+            got_tiles = tile_dims(tuple(spec), ndim, axis_sizes)
+            want_tiles = tile_dims(want, ndim, axis_sizes)
+            verdict["checked"] += 1
+            if got_tiles != want_tiles:
+                verdict["ok"] = False
+                verdict["mismatches"].append(
+                    f"{role}/{path}: committed {spec} (tiles "
+                    f"{list(got_tiles)}), rules derive "
+                    f"{spec_str(want)} (tiles {list(want_tiles)})")
+    return verdict

@@ -131,6 +131,148 @@ def parse_replica_groups(line: str) -> tuple[tuple[int, ...], ...] | None:
     return None
 
 
+# --------------------------------------------------------------- shardings
+#
+# Entry-param/output sharding annotations of *compiled* HLO: what the
+# rule-based analyzer (``analysis.rules``) lints against.  A compiled
+# entry parameter line looks like
+#
+#   %param.1 = f32[2,16,4]{2,1,0} parameter(1),
+#       sharding={devices=[1,1,2,4]<=[4,2]T(1,0) last_tile_dim_replicate},
+#       metadata={op_name="p['layers']['wq']"}
+#
+# and the V1 literal form spells the device list out:
+#   sharding={devices=[2,4]0,1,2,3,4,5,6,7}
+#
+# The analyzer compares *tile factor per dimension* (how many ways each
+# dim is split), which both forms carry in the leading dims vector —
+# device order is the replica-group lint's job, not this one's.
+
+# the {...} payload of one sharding= attribute
+_SHARDING_ATTR_RE = re.compile(r"sharding=\{([^{}]*(?:\{[^{}]*\}[^{}]*)*)\}")
+# V1/V2 tile dims: devices=[2,4]... — the dims vector is common to both
+_SHARDING_DEVICES_RE = re.compile(r"devices=\[([\d,]+)\]")
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+_PARAM_NO_RE = re.compile(r"parameter\((\d+)\)")
+
+
+@dataclass(frozen=True)
+class ShardingAnnotation:
+    """One parsed ``sharding={...}`` attribute (array, not tuple)."""
+    raw: str
+    replicated: bool = False
+    maximal: bool = False                  # {maximal device=k}
+    tile_dims: tuple[int, ...] = ()        # tile factor per array dim
+    last_tile_dim_replicate: bool = False
+
+    def tiles(self, ndim: int) -> tuple[int, ...]:
+        """Tile factor per array dimension, normalized to ``ndim``
+        entries: replicated/maximal -> all 1s; a trailing
+        last_tile_dim_replicate (or subgroup manual) dim is dropped."""
+        if self.replicated or self.maximal:
+            return (1,) * ndim
+        dims = self.tile_dims
+        if len(dims) > ndim:          # replicate/manual subgroup tail
+            dims = dims[:ndim]
+        return tuple(dims) + (1,) * (ndim - len(dims))
+
+
+def parse_sharding(text: str) -> ShardingAnnotation | None:
+    """Parse the first ``sharding={...}`` attribute on one HLO line (or a
+    bare ``{...}`` payload).  Returns None when the line carries none.
+    Tuple shardings (``{{...}, {...}}``) should be split by the caller
+    (see :func:`entry_output_shardings`)."""
+    m = _SHARDING_ATTR_RE.search(text)
+    payload = m.group(1) if m else None
+    if payload is None:
+        if text.lstrip().startswith("{") or "devices=" in text \
+                or "replicated" in text or "maximal" in text:
+            payload = text.strip().strip("{}")
+        else:
+            return None
+    payload = payload.strip()
+    if payload.startswith("replicated"):
+        return ShardingAnnotation(raw=payload, replicated=True)
+    if payload.startswith("maximal"):
+        return ShardingAnnotation(raw=payload, maximal=True)
+    dm = _SHARDING_DEVICES_RE.search(payload)
+    if not dm:
+        return None
+    dims = tuple(int(d) for d in dm.group(1).split(","))
+    return ShardingAnnotation(
+        raw=payload, tile_dims=dims,
+        last_tile_dim_replicate="last_tile_dim_replicate" in payload)
+
+
+@dataclass(frozen=True)
+class EntryParamSharding:
+    """One entry-computation parameter of a compiled module."""
+    index: int
+    dtype: str = ""
+    dims: tuple[int, ...] = ()             # LOCAL (per-shard) dims in SPMD
+    sharding: ShardingAnnotation | None = None
+    op_name: str = ""                      # jax keypath, e.g. "p['embed']"
+    line: str = field(default="", compare=False)
+
+
+def _entry_lines(text: str):
+    """The instruction lines of the ENTRY computation only — nested
+    computations (scan bodies, fusions) carry parameters too."""
+    inside = False
+    for raw in text.splitlines():
+        if raw.startswith("ENTRY"):
+            inside = True
+            continue
+        if inside:
+            if raw.strip() == "}":
+                return
+            yield raw
+
+
+def entry_parameter_shardings(text: str) -> list[EntryParamSharding]:
+    """Every ``parameter(i)`` of the ENTRY computation with its parsed
+    sharding annotation (None when the compiler printed none), sorted by
+    parameter index — which is the flatten order of the jitted callable's
+    arguments, so rule-derived specs join positionally."""
+    out = []
+    for raw in _entry_lines(text):
+        if "parameter(" not in raw:
+            continue
+        pm = _PARAM_NO_RE.search(raw)
+        if not pm:
+            continue
+        shape = parse_shape(raw.split("=", 1)[1].strip()) \
+            if "=" in raw else None
+        nm = _OP_NAME_RE.search(raw)
+        out.append(EntryParamSharding(
+            index=int(pm.group(1)),
+            dtype=shape[0] if shape else "",
+            dims=shape[1] if shape else (),
+            sharding=parse_sharding(raw),
+            op_name=nm.group(1) if nm else "",
+            line=raw.strip()))
+    return sorted(out, key=lambda p: p.index)
+
+
+def entry_output_shardings(text: str) -> list[ShardingAnnotation | None]:
+    """The ROOT tuple's per-element sharding annotations (flatten order
+    of the jitted callable's outputs), or ``[]`` when the compiled entry
+    root carries no sharding attribute — output lint is best-effort."""
+    for raw in _entry_lines(text):
+        if not raw.lstrip().startswith("ROOT"):
+            continue
+        m = _SHARDING_ATTR_RE.search(raw)
+        if not m:
+            return []
+        payload = m.group(1)
+        parts = re.findall(r"\{[^{}]*\}", payload)
+        if not parts:                      # single-array root
+            ann = parse_sharding(raw)
+            return [ann] if ann else []
+        return [parse_sharding(p) for p in parts]
+    return []
+
+
 @dataclass(frozen=True)
 class CollectiveInstance:
     """One collective instruction parsed out of compiled HLO text."""

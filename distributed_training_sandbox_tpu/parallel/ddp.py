@@ -242,11 +242,11 @@ def make_ddp_train_step(
                 loss = loss + 0.0 * C.barrier(axis)
         return params, opt_state, loss
 
-    state_spec = ((P(), P(axis)) if quantize_grads and error_feedback
+    state_spec = ((P(), P(axis)) if quantize_grads and error_feedback  # spec-ok
                   else P())
     sharded_step = C.smap(
         step, mesh,
-        in_specs=(P(), state_spec, P(axis)),
+        in_specs=(P(), state_spec, P(axis)),  # spec-ok
         out_specs=(P(), state_spec, P()),
     )
     return jax.jit(sharded_step, donate_argnums=(0, 1) if donate else ())

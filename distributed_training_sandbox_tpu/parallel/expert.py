@@ -484,7 +484,7 @@ def make_moe_lm_train_step(
                                         for k in path) else 1.0),
             params_sharded)
     state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
-    batch_spec = (P((dp_axis, ep_axis)) if sp_axis is None
+    batch_spec = (P((dp_axis, ep_axis)) if sp_axis is None  # spec-ok
                   else P((dp_axis, ep_axis), sp_axis))
     sharded = C.smap(step, mesh,
                      in_specs=(specs, state_specs, batch_spec),
@@ -536,6 +536,6 @@ def make_ep_train_step(
 
     state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
     sharded = C.smap(step, mesh,
-                     in_specs=(specs, state_specs, P(axis)),
+                     in_specs=(specs, state_specs, P(axis)),  # spec-ok
                      out_specs=(specs, state_specs, P()))
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())

@@ -363,7 +363,7 @@ def make_fsdp_train_step(
     layer_specs = specs["layers"]
     # Inside the scan body each stacked leaf has lost its layer dim, so its
     # sharded dim shifts from 1 to 0.
-    hook_specs = jax.tree.map(lambda s: P(*s[1:]), layer_specs,
+    hook_specs = jax.tree.map(lambda s: P(*s[1:]), layer_specs,  # spec-ok
                               is_leaf=lambda x: isinstance(x, P))
 
     fuse = {"ring_fused": "xla", "ring_fused_pallas": "pallas"}.get(
@@ -441,7 +441,7 @@ def make_fsdp_train_step(
         state_specs = optim.AdamState(mu=sspec, nu=sspec, count=P())
     else:
         state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
-    batch_spec = P(axis) if sp_axis is None else P(axis, sp_axis)
+    batch_spec = P(axis) if sp_axis is None else P(axis, sp_axis)  # spec-ok
     sharded = C.smap(step, mesh,
                      in_specs=(specs, state_specs, batch_spec),
                      out_specs=(specs, state_specs, P()))
@@ -485,7 +485,7 @@ def make_fsdp_auto_train_step(
                           is_leaf=lambda x: isinstance(x, P))
     sshard = optim.AdamState(mu=pshard, nu=pshard,
                              count=NamedSharding(mesh, P()))
-    bshard = NamedSharding(mesh, P(axis))
+    bshard = NamedSharding(mesh, P(axis))  # spec-ok
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(

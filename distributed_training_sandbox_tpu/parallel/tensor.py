@@ -209,7 +209,7 @@ def make_tp_train_step(
         return shards, opt_state, loss
 
     state_specs = optim.AdamState(mu=specs, nu=specs, count=P())
-    batch_spec = P(dp_axis) if sp_axis is None else P(dp_axis, sp_axis)
+    batch_spec = P(dp_axis) if sp_axis is None else P(dp_axis, sp_axis)  # spec-ok
     sharded = C.smap(step, mesh,
                      in_specs=(specs, state_specs, batch_spec),
                      out_specs=(specs, state_specs, P()))

@@ -193,9 +193,9 @@ def make_zero_train_step(
                 loss = loss + 0.0 * C.barrier(axis)
         return params, opt_state, loss
 
-    state_specs = optim.AdamState(mu=P(axis), nu=P(axis), count=P())
+    state_specs = optim.AdamState(mu=P(axis), nu=P(axis), count=P())  # spec-ok
     sharded = C.smap(step, mesh,
-                     in_specs=(P(), state_specs, P(axis)),
+                     in_specs=(P(), state_specs, P(axis)),  # spec-ok
                      out_specs=(P(), state_specs, P()))
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
@@ -289,10 +289,10 @@ def make_zero3_train_step(
                 loss = loss + 0.0 * C.barrier(axis)
         return chunk_params, opt_state, loss
 
-    state_specs = optim.AdamState(mu=P(axis), nu=P(axis), count=P())
+    state_specs = optim.AdamState(mu=P(axis), nu=P(axis), count=P())  # spec-ok
     sharded = C.smap(step, mesh,
-                     in_specs=(P(axis), state_specs, P(axis)),
-                     out_specs=(P(axis), state_specs, P()))
+                     in_specs=(P(axis), state_specs, P(axis)),  # spec-ok
+                     out_specs=(P(axis), state_specs, P()))  # spec-ok
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
 

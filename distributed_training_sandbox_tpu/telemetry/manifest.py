@@ -76,6 +76,10 @@ class RunManifest:
     started_utc: str = ""
     collective_counts: dict | None = None
     contract: dict | None = None
+    # the partition-rule verdict (analysis.rules.rules_manifest_verdict):
+    # rule hygiene over the live trees + committed NamedSharding specs
+    # vs the rule-derived ones, recorded beside the static contract mark
+    rules: dict | None = None
     # restart lineage (resilience.supervisor): attempt index, restart
     # budget, resumed_from_step, the resume contract re-check, and the
     # prior segments' {run_id, start/end_step, status} records —
@@ -97,6 +101,7 @@ class RunManifest:
                 config: Any = None, mesh=None, model: str | None = None,
                 collective_counts: dict | None = None,
                 contract: dict | None = None,
+                rules: dict | None = None,
                 lineage: dict | None = None,
                 extra: dict | None = None) -> "RunManifest":
         """Snapshot the environment at step 0.  ``mesh`` is a
@@ -134,6 +139,7 @@ class RunManifest:
                 datetime.timezone.utc).isoformat(timespec="seconds"),
             collective_counts=collective_counts,
             contract=contract,
+            rules=rules,
             lineage=dict(lineage) if lineage else None,
             extra=dict(extra or {}),
         )

@@ -65,6 +65,7 @@ class TelemetryRun:
                  model: str | None = None,
                  collective_counts: dict | None = None,
                  contract: dict | None = None,
+                 rules: dict | None = None,
                  lineage: dict | None = None,
                  extra: dict | None = None,
                  results_dir: str | None = None,
@@ -79,6 +80,7 @@ class TelemetryRun:
         self.model = model
         self.collective_counts = collective_counts
         self.contract = contract
+        self.rules = rules
         self.lineage = lineage
         self.extra = extra
         self.profiler = profiler
@@ -186,6 +188,7 @@ class TelemetryRun:
                 mesh=self.mesh, model=self.model,
                 collective_counts=self.collective_counts,
                 contract=self.contract,
+                rules=self.rules,
                 lineage=self.lineage,
                 extra=extra)
             self.writer = MetricsWriter(self.run_dir)

@@ -158,6 +158,12 @@ def _leg(mode, args, rest, cfg, ctx, plan=None):
                                 n_layers=mcfg.num_hidden_layers)
     print(f"[{name}] contract[{cname}]: {verdict.summary()}")
     ctx.verify_contract(verdict)
+    from distributed_training_sandbox_tpu.analysis import (
+        rules_manifest_verdict)
+    rules_verdict = rules_manifest_verdict(cname, params=shards)
+    print(f"[{name}] rules[{cname}]: "
+          f"{'ok' if rules_verdict['ok'] else 'MISMATCH'} "
+          f"({rules_verdict.get('checked', 0)} leaves checked)")
 
     flops_tok = get_model_flops_per_token(mcfg, cfg.sequence_length)
     tracker = PerformanceTracker(
@@ -186,6 +192,7 @@ def _leg(mode, args, rest, cfg, ctx, plan=None):
             name, config=cfg, mesh=mesh, model=args.model,
             collective_counts=counts, profiler=prof,
             contract=verdict.to_dict(),
+            rules=rules_verdict,
             lineage=ctx.manifest_lineage(),
             extra={mode: second, **tuner_stamp}) as telem:
         pref.spans = telem.spans   # prefetch waits onto the timeline

@@ -218,10 +218,16 @@ def _zero_ab_leg(stage, args, cfg, root_ctx, plan=None):
                                      mesh=mesh)
     print(f"[{name}] contract[ddp/baseline]: {base_verdict.summary()}")
     ctx_a.verify_contract(base_verdict)
+    from distributed_training_sandbox_tpu.analysis import (
+        rules_manifest_verdict)
+    base_rules = rules_manifest_verdict("ddp", params=params)
+    print(f"[{name}] rules[ddp/baseline]: "
+          f"{'ok' if base_rules['ok'] else 'MISMATCH'}")
     # one TelemetryRun per leg: the crash-safe owner of that leg's profiler
     with TelemetryRun(f"{name}-baseline", config=cfg, mesh=mesh,
                       model="toy-mlp", collective_counts=base_counts,
                       contract=base_verdict.to_dict(),
+                      rules=base_rules,
                       lineage=ctx_a.manifest_lineage(),
                       profiler=make_prof("baseline"),
                       extra={"leg": "baseline", "stage": stage,
@@ -255,9 +261,15 @@ def _zero_ab_leg(stage, args, cfg, root_ctx, plan=None):
         **({"rebuild": args.rebuild} if stage in (1, 2) else {}))
     print(f"[{name}] contract[{name}]: {shard_verdict.summary()}")
     ctx_b.verify_contract(shard_verdict)
+    # leg B placement check over the leg's actual param tree (zero3's is
+    # the sharded flat-chunk tree, 1/2 keep the replicated one)
+    shard_rules = rules_manifest_verdict(name, params=state0[0])
+    print(f"[{name}] rules[{name}]: "
+          f"{'ok' if shard_rules['ok'] else 'MISMATCH'}")
     with TelemetryRun(name, config=cfg, mesh=mesh, model="toy-mlp",
                       collective_counts=shard_counts,
                       contract=shard_verdict.to_dict(),
+                      rules=shard_rules,
                       lineage=ctx_b.manifest_lineage(),
                       profiler=make_prof("sharded"),
                       extra={"leg": "sharded", "stage": stage,

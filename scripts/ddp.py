@@ -163,6 +163,12 @@ def _mlp_leg(args, cfg, ctx):
         **({"bucket_mb": cfg.bucket_mb} if cfg.bucket_mb else {}))
     print(f"[ddp] contract[{contract_name}]: {verdict.summary()}")
     ctx.verify_contract(verdict)
+    from distributed_training_sandbox_tpu.analysis import (
+        rules_manifest_verdict)
+    rules_verdict = rules_manifest_verdict(contract_name, params=params)
+    print(f"[ddp] rules[{contract_name}]: "
+          f"{'ok' if rules_verdict['ok'] else 'MISMATCH'} "
+          f"({rules_verdict.get('checked', 0)} leaves checked)")
 
     tracker = PerformanceTracker(warmup_steps=min(5, cfg.num_steps - 1) if
                                  cfg.num_steps > 1 else 0)
@@ -183,6 +189,7 @@ def _mlp_leg(args, cfg, ctx):
     with pref, TelemetryRun("ddp", config=cfg, mesh=mesh, model="mlp",
                             collective_counts=counts,
                             contract=verdict.to_dict(),
+                            rules=rules_verdict,
                             lineage=ctx.manifest_lineage(),
                             profiler=prof) as telem:
         pref.spans = telem.spans   # prefetch waits onto the timeline
@@ -323,6 +330,12 @@ def _classification_leg(args, cfg, ctx):
         **({"bucket_mb": cfg.bucket_mb} if cfg.bucket_mb else {}))
     print(f"[ddp] contract[{contract_name}]: {verdict.summary()}")
     ctx.verify_contract(verdict)
+    from distributed_training_sandbox_tpu.analysis import (
+        rules_manifest_verdict)
+    rules_verdict = rules_manifest_verdict(contract_name, params=params)
+    print(f"[ddp] rules[{contract_name}]: "
+          f"{'ok' if rules_verdict['ok'] else 'MISMATCH'} "
+          f"({rules_verdict.get('checked', 0)} leaves checked)")
 
     tracker = PerformanceTracker(warmup_steps=min(3, cfg.num_steps - 1) if
                                  cfg.num_steps > 1 else 0)
@@ -342,6 +355,7 @@ def _classification_leg(args, cfg, ctx):
     with pref, TelemetryRun("ddp", config=cfg, mesh=mesh, model=args.model,
                             collective_counts=counts,
                             contract=verdict.to_dict(),
+                            rules=rules_verdict,
                             lineage=ctx.manifest_lineage(),
                             profiler=prof) as telem:
         pref.spans = telem.spans   # prefetch waits onto the timeline

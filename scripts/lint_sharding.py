@@ -12,15 +12,26 @@ For every strategy (or a ``--strategies`` subset) this:
      contract's declared mesh axes (``analysis.hlo_lint``);
   4. executes 3 steps and fails on any retrace after the first
      (``analysis.recompile``; skip with ``--skip-recompile``);
+  5. under ``--rules``: checks partition-rule hygiene
+     (``analysis.rules`` — unmatched leaves, dead rules, shadowed
+     rules) and compares every compiled entry parameter's
+     ``sharding={...}`` annotation against its rule-derived spec
+     (``hlo_lint.check_sharding_drift``);
 
 then AST-lints ``scripts/`` for eager-loop / collective-scope /
-donation pitfalls (``analysis.pitfalls``).
+donation pitfalls (``analysis.pitfalls``).  ``--diff-contracts``
+cross-checks every RuleSet-generated contract against its
+hand-registered twin (``analysis.contract_gen``) and fails on any
+field-level divergence.
 
 Exit status is nonzero on any contract violation, error-severity lint
 finding, or detected recompile — wire it into CI next to the test
-suite.  ``--json PATH`` (or ``-`` for stdout) writes the full report.
+suite.  ``--json PATH`` (or ``-`` for stdout) writes the full report
+(``schema_version`` 2: adds the ``rules`` and ``diff_contracts``
+verdicts ``scripts/runs.py`` indexes).
 
   python scripts/lint_sharding.py --cpu-devices 8
+  python scripts/lint_sharding.py --rules --diff-contracts
   python scripts/lint_sharding.py --strategies ddp,zero1 --json -
 """
 
@@ -37,9 +48,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def analyze_strategy(name: str, *, skip_recompile: bool = False,
-                     skip_compiled: bool = False, n_steps: int = 4) -> dict:
-    """Contract + HLO lint + recompile report for one strategy.  Returns
-    the per-strategy report dict (key ``ok`` rolls the three up)."""
+                     skip_compiled: bool = False, rules: bool = False,
+                     n_steps: int = 4) -> dict:
+    """Contract + HLO lint + recompile (+ rule-drift) report for one
+    strategy.  Returns the per-strategy report dict (key ``ok`` rolls
+    them up)."""
     from distributed_training_sandbox_tpu.analysis import (
         check_counts, lint_compiled_hlo)
     from distributed_training_sandbox_tpu.analysis.fixtures import (
@@ -56,11 +69,51 @@ def analyze_strategy(name: str, *, skip_recompile: bool = False,
 
     counts = count_collectives(lowered.as_text())
     verdict = check_counts(build.contract, counts, build.ctx)
-    report = {"contract": verdict.to_dict(), "lint": [], "recompile": None}
+    report = {"contract": verdict.to_dict(), "lint": [], "recompile": None,
+              "rules": None}
     print(f"[lint] {name:6s} contract: {verdict.summary()}")
 
+    # the --rules leg needs the compiled module even under
+    # --skip-compiled: drift lives in the post-SPMD annotations
+    compiled = (lowered.compile().as_text()
+                if rules or not skip_compiled else None)
+
+    if rules:
+        from distributed_training_sandbox_tpu.analysis.hlo_lint import (
+            check_sharding_drift)
+        from distributed_training_sandbox_tpu.analysis.rules import (
+            RULESETS, expected_arg_specs)
+        rs = RULESETS.get(name)
+        if rs is None:
+            report["rules"] = {"ok": False, "checked": 0,
+                               "mismatches": [], "hygiene_ok": False,
+                               "errors": [f"no RuleSet registered for "
+                                          f"{name!r}"]}
+            print(f"[lint] {name:6s} rules: ERROR no RuleSet registered")
+        else:
+            expected, match_reports = expected_arg_specs(rs, build.args)
+            hygiene_errors = [e for r in match_reports for e in r.errors]
+            hygiene_warns = [w for r in match_reports for w in r.warnings]
+            findings, stats = check_sharding_drift(
+                compiled, expected, mesh=build.mesh)
+            stats["hygiene_ok"] = not hygiene_errors
+            stats["errors"] = hygiene_errors
+            stats["warnings"] = hygiene_warns
+            stats["ok"] = bool(stats["ok"]) and not hygiene_errors
+            report["rules"] = stats
+            for e in hygiene_errors:
+                print(f"[lint] {name:6s} rules hygiene error: {e}")
+            for w in hygiene_warns:
+                print(f"[lint] {name:6s} rules hygiene warn: {w}")
+            for f in findings:
+                print(f"[lint] {name:6s} {f.severity}: [{f.check}] "
+                      f"{f.message}")
+            if stats["ok"] and not findings:
+                print(f"[lint] {name:6s} rules: clean "
+                      f"({stats['checked']} entry params against "
+                      f"rule-derived specs, {stats['skipped']} uncovered)")
+
     if not skip_compiled:
-        compiled = lowered.compile().as_text()
         # strategies whose contract declares host offload get their
         # MoveToHost/MoveToDevice sites count-checked instead of flagged
         declared = (build.contract.host_transfers(build.ctx)
@@ -87,8 +140,33 @@ def analyze_strategy(name: str, *, skip_recompile: bool = False,
     report["ok"] = (
         verdict.ok
         and not any(f["severity"] == "error" for f in report["lint"])
-        and (report["recompile"] is None or report["recompile"]["ok"]))
+        and (report["recompile"] is None or report["recompile"]["ok"])
+        and (report["rules"] is None or report["rules"]["ok"]))
     return report
+
+
+def check_contract_diff(report: dict) -> None:
+    """The ``--diff-contracts`` gate: every RuleSet-generated contract
+    must agree field-by-field with its hand-registered twin over the
+    synthetic context grid (``analysis.contract_gen.diff_all_contracts``).
+    A divergence is either a generator bug or a latent calibration bug
+    in the hand contract — both gate."""
+    from distributed_training_sandbox_tpu.analysis.contract_gen import (
+        diff_all_contracts)
+    diffs = diff_all_contracts()
+    bad = {s: d for s, d in diffs.items() if not d.ok}
+    report["diff_contracts"] = {
+        "ok": not bad,
+        "strategies": len(diffs),
+        "divergent": {s: d.divergences for s, d in bad.items()},
+    }
+    for d in bad.values():
+        print(f"[lint] {d.describe()}")
+    if bad:
+        report["ok"] = False
+    else:
+        print(f"[lint] diff-contracts: generated == hand-registered for "
+              f"all {len(diffs)} strategies")
 
 
 def check_ledger_run(run_dir: str) -> int:
@@ -181,30 +259,49 @@ def check_memory_run(run_dir: str) -> int:
     return 0
 
 
-def check_contract_coverage(report: dict, *, strict: bool) -> None:
+def check_contract_coverage(report: dict, *, strict: bool = True) -> None:
     """Registry ↔ contract cross-check: a strategy registered with
     ``fixtures.register_strategy`` but absent from ``CONTRACTS`` is an
-    analyzer blind spot (error — a driver nobody's choreography gates);
-    a contract with no registered builder is dead weight (warning,
-    error under ``--strict``)."""
+    analyzer blind spot, and a contract with no registered builder is a
+    choreography nobody exercises — both are errors in the default CI
+    gate (the builder-less case was a warning until the coverage sweep
+    came back clean; ``strict`` is kept for callers that want the old
+    lenient read)."""
     from distributed_training_sandbox_tpu.analysis.fixtures import (
         contract_coverage)
+    from distributed_training_sandbox_tpu.analysis.rules import (
+        ruleset_coverage)
     missing, orphans = contract_coverage()
     for s in missing:
         print(f"[lint] coverage error: strategy {s!r} is registered "
               f"but has no CONTRACTS entry — its collectives are "
               f"un-gated")
+    sev = "error" if strict else "warn"
     for s in orphans:
-        print(f"[lint] coverage warn: contract {s!r} has no registered "
+        print(f"[lint] coverage {sev}: contract {s!r} has no registered "
               f"fixture builder — the analyzer never exercises it")
+    # the rules registry joins the same cross-check: every contracted
+    # strategy needs a RuleSet (else the --rules leg is blind to it),
+    # every RuleSet needs a contract (else its choreography is un-gated)
+    rules_missing, rules_orphans = ruleset_coverage()
+    for s in rules_missing:
+        print(f"[lint] coverage error: contract {s!r} has no RuleSet — "
+              f"the --rules drift lint never covers it")
+    for s in rules_orphans:
+        print(f"[lint] coverage error: RuleSet {s!r} has no contract — "
+              f"its derived choreography gates nothing")
     report["coverage"] = {"missing_contract": missing,
                           "unregistered_fixture": orphans,
-                          "ok": not missing and not (strict and orphans)}
+                          "missing_ruleset": rules_missing,
+                          "orphan_ruleset": rules_orphans,
+                          "ok": (not missing and not (strict and orphans)
+                                 and not rules_missing
+                                 and not rules_orphans)}
     if not report["coverage"]["ok"]:
         report["ok"] = False
-    if not missing and not orphans:
+    if report["coverage"]["ok"] and not orphans:
         print(f"[lint] coverage: every registered strategy has a "
-              f"contract and vice versa")
+              f"contract and a RuleSet, and vice versa")
 
 
 def main(argv=None) -> int:
@@ -226,6 +323,14 @@ def main(argv=None) -> int:
     p.add_argument("--scripts-dir", type=str,
                    default=str(Path(__file__).resolve().parent),
                    help="directory whose *.py get the AST pitfall lint")
+    p.add_argument("--rules", action="store_true",
+                   help="partition-rule leg: rule hygiene per strategy "
+                        "plus compiled entry-param sharding vs the "
+                        "rule-derived specs (drift = error)")
+    p.add_argument("--diff-contracts", action="store_true",
+                   help="cross-check every RuleSet-generated contract "
+                        "against its hand-registered twin; any "
+                        "field-level divergence fails the run")
     p.add_argument("--strict", action="store_true",
                    help="warnings also fail the run")
     p.add_argument("--json", dest="json_out", type=str, default=None,
@@ -255,12 +360,19 @@ def main(argv=None) -> int:
         from distributed_training_sandbox_tpu.utils import use_cpu_devices
         use_cpu_devices(args.cpu_devices)
 
-    report: dict = {"strategies": {}, "pitfalls": [], "ok": True}
-    check_contract_coverage(report, strict=args.strict)
+    # schema_version 2: adds per-strategy "rules" verdicts and the
+    # top-level "diff_contracts" verdict (both null when the legs are
+    # off), indexed by scripts/runs.py next to the ledger verdicts
+    report: dict = {"schema_version": 2, "strategies": {},
+                    "pitfalls": [], "diff_contracts": None, "ok": True}
+    check_contract_coverage(report)
+    if args.diff_contracts:
+        check_contract_diff(report)
 
     for name in [s for s in args.strategies.split(",") if s]:
         sub = analyze_strategy(name, skip_recompile=args.skip_recompile,
-                               skip_compiled=args.skip_compiled)
+                               skip_compiled=args.skip_compiled,
+                               rules=args.rules)
         report["strategies"][name] = sub
         report["ok"] &= sub["ok"]
 
@@ -271,13 +383,18 @@ def main(argv=None) -> int:
         # too: a silent `except Exception: pass` around a collective in
         # library code is exactly as hang-prone as one in a script —
         # plus the pallas-call-no-interpret check: every kernel wrapper
-        # in library code must plumb the CPU-tier interpret knob
+        # in library code must plumb the CPU-tier interpret knob — and
+        # the hand-rolled-partition-spec check: step makers in modules
+        # the rule engine covers must not invent PartitionSpecs outside
+        # the declared `# spec-ok` seams (the rules are the one source
+        # of truth the drift lint checks compiled HLO against)
         pkg_dir = Path(args.scripts_dir).resolve().parent \
             / "distributed_training_sandbox_tpu"
         if pkg_dir.is_dir():
             findings += lint_tree(pkg_dir, recursive=True,
                                   checks={"swallowed-distributed-error",
-                                          "pallas-call-no-interpret"})
+                                          "pallas-call-no-interpret",
+                                          "hand-rolled-partition-spec"})
         # the serving modules additionally get the host-sync lint: the
         # engine/fleet hot path may only block at its declared sync
         # points (each carries a `# sync-ok` pragma) — an undeclared

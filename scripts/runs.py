@@ -75,7 +75,18 @@ CREATE TABLE IF NOT EXISTS runs (
     step_time_ms     REAL,
     final_loss       REAL,
     host_sync_count  REAL,
+    contract_ok   INTEGER,
+    rules_ok      INTEGER,
     summary_json  TEXT
+);
+CREATE TABLE IF NOT EXISTS lint_verdicts (
+    report       TEXT NOT NULL,
+    strategy     TEXT NOT NULL,
+    contract_ok  INTEGER,
+    rules_ok     INTEGER,
+    diff_contracts_ok INTEGER,
+    ok           INTEGER,
+    PRIMARY KEY (report, strategy)
 );
 CREATE TABLE IF NOT EXISTS ledger_aggregates (
     run_id         TEXT NOT NULL,
@@ -115,7 +126,21 @@ def connect(db_path: str) -> sqlite3.Connection:
     conn = sqlite3.connect(db_path)
     conn.row_factory = sqlite3.Row
     conn.executescript(_SCHEMA_SQL)
+    # migrate pre-existing dbs created before the static-verdict columns
+    # (CREATE TABLE IF NOT EXISTS never alters an existing table)
+    for col in ("contract_ok", "rules_ok"):
+        try:
+            conn.execute(f"ALTER TABLE runs ADD COLUMN {col} INTEGER")
+        except sqlite3.OperationalError:
+            pass  # already present
     return conn
+
+
+def _ok_int(verdict) -> int | None:
+    """A manifest/report verdict dict -> 1/0/NULL for the index."""
+    if not isinstance(verdict, dict) or "ok" not in verdict:
+        return None
+    return 1 if verdict.get("ok") else 0
 
 
 def _load_json(path: Path) -> dict | None:
@@ -148,6 +173,10 @@ def index_run_dir(conn: sqlite3.Connection, run_dir: str) -> str | None:
         "rank": extra.get("rank", man.get("process_index", 0)),
         "started_utc": man.get("started_utc"),
         "device_count": man.get("device_count"),
+        # the two static marks the manifest records at step 0: the
+        # collective-contract verdict and the partition-rules verdict
+        "contract_ok": _ok_int(man.get("contract")),
+        "rules_ok": _ok_int(man.get("rules")),
         "summary_json": json.dumps(summary),
     }
     for m in _METRICS:
@@ -179,6 +208,31 @@ def index_run_dir(conn: sqlite3.Connection, run_dir: str) -> str | None:
                 (run_id, key, gb))
     conn.commit()
     return run_id
+
+
+def index_lint_report(conn: sqlite3.Connection, path: str) -> int:
+    """Upsert one ``scripts/lint_sharding.py --json`` report
+    (``schema_version`` >= 2) into ``lint_verdicts``: one row per
+    strategy with its contract / rules verdicts plus the report-wide
+    diff-contracts verdict — queryable next to the runs table's
+    ledger-backed marks.  Returns the number of strategies indexed."""
+    doc = _load_json(Path(path))
+    if doc is None or int(doc.get("schema_version") or 0) < 2 \
+            or "strategies" not in doc:
+        return 0
+    report = str(Path(path).resolve())
+    diff_ok = _ok_int(doc.get("diff_contracts"))
+    conn.execute("DELETE FROM lint_verdicts WHERE report = ?", (report,))
+    n = 0
+    for name, sub in (doc.get("strategies") or {}).items():
+        conn.execute(
+            "INSERT OR REPLACE INTO lint_verdicts VALUES (?,?,?,?,?,?)",
+            (report, name, _ok_int(sub.get("contract")),
+             _ok_int(sub.get("rules")), diff_ok,
+             1 if sub.get("ok") else 0))
+        n += 1
+    conn.commit()
+    return n
 
 
 def index_chaos_report(conn: sqlite3.Connection, path: str) -> int:
@@ -450,6 +504,13 @@ def _cmd_index(conn, args) -> int:
     ids = index_results_dir(conn, args.results_dir)
     for d in args.run_dirs:
         if Path(d).is_file() and d.endswith(".json"):
+            # a JSON arg is a report, not a run dir: lint_sharding --json
+            # (schema_version >= 2) or a chaos campaign report
+            n = index_lint_report(conn, d)
+            if n:
+                print(f"[runs] indexed lint report ({n} strategies) "
+                      f"from {d}")
+                continue
             n = index_chaos_report(conn, d)
             print(f"[runs] indexed chaos report ({n} cells) from {d}")
             continue

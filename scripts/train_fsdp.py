@@ -221,17 +221,27 @@ def _leg(args, rest, cfg, ctx):
     # contract; ring_fused's decomposed-matmul site counts are pinned by
     # tests/test_overlap.py rather than a registry formula
     verdict = None
+    cname = ("fsdp_ring" if cfg.overlap == "ring"
+             else "fsdp_offload" if cfg.offload != "none" else "fsdp")
     if args.variant == "explicit" and cfg.overlap != "ring_fused":
         from distributed_training_sandbox_tpu.analysis import (
             evaluate_contract)
-        cname = ("fsdp_ring" if cfg.overlap == "ring"
-                 else "fsdp_offload" if cfg.offload != "none" else "fsdp")
         verdict = evaluate_contract(cname, counts, params=shards,
                                     mesh=mesh,
                                     n_layers=mcfg.num_hidden_layers,
                                     offload=oplan.to_dict())
         print(f"[fsdp] contract[{cname}]: {verdict.summary()}")
     ctx.verify_contract(verdict)
+
+    # partition-rule verdict for the manifest: committed param shardings
+    # vs the rule-derived specs (the compiled-HLO drift lint is
+    # scripts/lint_sharding.py --rules' job)
+    from distributed_training_sandbox_tpu.analysis import (
+        rules_manifest_verdict)
+    rules_verdict = rules_manifest_verdict(cname, params=shards)
+    print(f"[fsdp] rules[{cname}]: "
+          f"{'ok' if rules_verdict['ok'] else 'MISMATCH'} "
+          f"({rules_verdict.get('checked', 0)} leaves checked)")
 
     # predicted vs compiler-reported waterline for the manifest: the
     # compile-side number costs an AOT compile, so it is only taken when
@@ -267,6 +277,7 @@ def _leg(args, rest, cfg, ctx):
             "fsdp", config=cfg, mesh=mesh, model=args.model,
             collective_counts=counts, profiler=prof,
             contract=verdict.to_dict() if verdict else None,
+            rules=rules_verdict,
             lineage=ctx.manifest_lineage(),
             extra={"variant": args.variant,
                    "reshard_after_forward": args.reshard,

@@ -138,6 +138,12 @@ def _leg(args, rest, cfg, ctx):
                                 top_k=args.top_k)
     print(f"[train_moe] contract[moe]: {verdict.summary()}")
     ctx.verify_contract(verdict)
+    from distributed_training_sandbox_tpu.analysis import (
+        rules_manifest_verdict)
+    rules_verdict = rules_manifest_verdict("moe", params=shards)
+    print(f"[train_moe] rules[moe]: "
+          f"{'ok' if rules_verdict['ok'] else 'MISMATCH'} "
+          f"({rules_verdict.get('checked', 0)} leaves checked)")
 
     tracker = PerformanceTracker(
         warmup_steps=min(3, max(cfg.num_steps - 1, 0)),
@@ -160,6 +166,7 @@ def _leg(args, rest, cfg, ctx):
             "moe", config=cfg, mesh=mesh, model=args.model,
             collective_counts=counts, profiler=prof,
             contract=verdict.to_dict(),
+            rules=rules_verdict,
             lineage=ctx.manifest_lineage(),
             extra={"experts": args.experts, "ep": args.ep,
                    "top_k": args.top_k}) as telem:

@@ -92,3 +92,111 @@ def test_instances_on_live_lowering(mesh8):
     (ar,) = [i for i in insts if i.kind == "all_reduce"]
     assert ar.replica_groups == ((0, 1, 2, 3, 4, 5, 6, 7),)
     assert ar.shapes == ((1, 4),)  # per-device shard of the (8,4) input
+
+
+def test_parse_replica_groups_iota_singleton_groups():
+    """[8,1]<=[8]: every device its own group — what a fully-sharded
+    axis degenerates to.  Must parse, not collapse to None."""
+    line = "x = f32[1] all-gather(f32[1] %p), replica_groups=[8,1]<=[8]"
+    assert parse_replica_groups(line) == tuple((i,) for i in range(8))
+
+
+def test_parse_replica_groups_literal_singleton_groups():
+    """Degenerate 1-device literal groups survive the literal parser."""
+    line = ("x = f32[1] collective-permute(f32[1] %p), "
+            "replica_groups={{0},{1},{2},{3}}")
+    assert parse_replica_groups(line) == ((0,), (1,), (2,), (3,))
+
+
+def test_parse_replica_groups_mixed_forms_in_one_module():
+    """A module mixing literal and iota forms: each instance decodes
+    under its own form (the per-line parser carries no module state)."""
+    text = """\
+HloModule jit_mixed, is_scheduled=true
+ENTRY %main {
+  %a = f32[4]{0} all-reduce(f32[4]{0} %p0), channel_id=1, replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=%add
+  %b = f32[8]{0} all-gather(f32[1]{0} %p1), channel_id=2, replica_groups=[2,4]<=[4,2]T(1,0), dimensions={0}
+}
+"""
+    insts = collective_instances(text)
+    assert [i.kind for i in insts] == ["all_reduce", "all_gather"]
+    assert insts[0].replica_groups == ((0, 1, 2, 3), (4, 5, 6, 7))
+    expect = np.arange(8).reshape(4, 2).T.reshape(2, 4)
+    assert insts[1].replica_groups == \
+        tuple(tuple(int(i) for i in row) for row in expect)
+
+
+# --------------------------------------- compiled sharding annotations
+
+SHARDED_HLO = """\
+HloModule jit_step, is_scheduled=true
+
+%region_0 (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+}
+
+ENTRY %main {
+  %p0 = f32[8,4]{1,0} parameter(0), sharding={devices=[2,4]0,1,2,3,4,5,6,7}, metadata={op_name="p['w']"}
+  %p1 = f32[8]{0} parameter(1), sharding={replicated}
+  %p2 = f32[2,16,4]{2,1,0} parameter(2), sharding={devices=[1,2,1,4]<=[8] last_tile_dim_replicate}
+  %p3 = s32[8,32]{1,0} parameter(3)
+  ROOT %t = (f32[8,4]{1,0}) tuple(f32[8,4]{1,0} %p0)
+}
+"""
+
+
+def test_entry_parameter_shardings_parses_both_forms():
+    from distributed_training_sandbox_tpu.ops.hlo import (
+        entry_parameter_shardings)
+
+    params = entry_parameter_shardings(SHARDED_HLO)
+    # nested-computation parameters never leak into the entry list
+    assert [p.index for p in params] == [0, 1, 2, 3]
+
+    p0 = params[0]                        # V1 literal device list
+    assert p0.dtype == "f32" and p0.dims == (8, 4)
+    assert p0.sharding.tile_dims == (2, 4)
+    assert p0.sharding.tiles(2) == (2, 4)
+    assert p0.op_name == "p['w']"
+
+    p1 = params[1]                        # replicated
+    assert p1.sharding.replicated and p1.sharding.tiles(1) == (1,)
+
+    p2 = params[2]                        # V2 iota + replicate tail
+    assert p2.sharding.last_tile_dim_replicate
+    assert p2.sharding.tiles(3) == (1, 2, 1)   # tail dim dropped
+
+    assert params[3].sharding is None     # compiler printed none
+
+
+def test_parse_sharding_maximal_and_bare_payload():
+    from distributed_training_sandbox_tpu.ops.hlo import parse_sharding
+
+    ann = parse_sharding("{maximal device=3}")
+    assert ann.maximal and ann.tiles(2) == (1, 1)
+    assert parse_sharding("no annotation here") is None
+    bare = parse_sharding("{devices=[4,2]<=[8]}")
+    assert bare.tiles(2) == (4, 2)
+
+
+def test_entry_parameter_shardings_on_live_compile(mesh8):
+    """The parser round-trips a real compiled module: a dp-sharded arg
+    tiles dim 0 by 8, a replicated arg tiles as all-1s."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from distributed_training_sandbox_tpu.ops.hlo import (
+        entry_parameter_shardings)
+
+    @jax.jit
+    def f(x, w):
+        return x @ w
+
+    x = jax.device_put(jnp.ones((8, 4)), NamedSharding(mesh8, P("dp")))
+    w = jax.device_put(jnp.ones((4, 2)), NamedSharding(mesh8, P()))
+    text = f.lower(x, w).compile().as_text()
+    params = entry_parameter_shardings(text)
+    assert [p.index for p in params] == [0, 1]
+    assert params[0].sharding.tiles(2) == (8, 1)
+    assert params[1].sharding.tiles(2) == (1, 1)

@@ -365,3 +365,61 @@ def test_package_tree_clean_of_swallowed_distributed_errors():
     assert not findings, [f.to_dict() for f in findings]
     assert not [f for f in lint_tree(SCRIPTS_DIR)
                 if f.check == "swallowed-distributed-error"]
+
+
+# ------------------------------------- hand-rolled-partition-spec lint
+
+SPEC_SRC = """
+from jax.sharding import PartitionSpec as P
+def make_train_step(mesh):
+    batch_spec = P("dp")
+    return batch_spec
+"""
+
+
+def test_hand_rolled_spec_fires_in_rule_covered_module():
+    (f,) = [x for x in lint_source(SPEC_SRC, path="fsdp.py")
+            if x.check == "hand-rolled-partition-spec"]
+    assert f.severity == SEV_ERROR and f.line == 4
+    assert "RuleSet" in f.message and "spec-ok" in f.message
+
+
+def test_hand_rolled_spec_suppressed_by_pragma():
+    src = SPEC_SRC.replace('P("dp")', 'P("dp")  # spec-ok')
+    assert "hand-rolled-partition-spec" not in _checks(
+        lint_source(src, path="fsdp.py"))
+
+
+def test_hand_rolled_spec_silent_in_uncovered_module():
+    assert "hand-rolled-partition-spec" not in _checks(
+        lint_source(SPEC_SRC, path="my_experiment.py"))
+
+
+def test_hand_rolled_spec_silent_outside_step_functions():
+    src = """
+from jax.sharding import PartitionSpec as P
+def describe_mesh(mesh):
+    return P("dp", "tp")
+"""
+    assert "hand-rolled-partition-spec" not in _checks(
+        lint_source(src, path="fsdp.py"))
+
+
+def test_trivial_replicated_spec_is_fine():
+    src = """
+from jax.sharding import PartitionSpec as P
+def make_train_step(mesh):
+    return P(), P(None)       # replicated / placeholder: no placement
+"""
+    assert "hand-rolled-partition-spec" not in _checks(
+        lint_source(src, path="fsdp.py"))
+
+
+def test_shipped_parallel_tree_spec_clean():
+    """The package's step makers carry `# spec-ok` on every declared
+    rules->sharding seam — the sweep the CI gate runs is clean."""
+    pkg = Path(__file__).resolve().parent.parent \
+        / "distributed_training_sandbox_tpu"
+    findings = [f for f in lint_tree(pkg, recursive=True,
+                                     checks={"hand-rolled-partition-spec"})]
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]

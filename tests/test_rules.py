@@ -1,0 +1,270 @@
+"""Rule-based sharding analyzer: partition-rule matching with the three
+hygiene checks, the generated-vs-hand contract differ, the compiled
+sharding-drift lint, the driver-side manifest verdict, and the CLI gate
+— all on the 8-way simulated CPU mesh."""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_training_sandbox_tpu.analysis.contract_gen import (
+    diff_all_contracts, generate_all_contracts)
+from distributed_training_sandbox_tpu.analysis.contracts import CONTRACTS
+from distributed_training_sandbox_tpu.analysis.fixtures import (
+    STRATEGIES, build_strategy)
+from distributed_training_sandbox_tpu.analysis.hlo_lint import (
+    check_sharding_drift)
+from distributed_training_sandbox_tpu.analysis.rules import (
+    RULESETS, Rule, expected_arg_specs, match_partition_rules,
+    mirror_opt_rules, named_leaf_paths, rules_manifest_verdict,
+    ruleset_coverage, tile_dims)
+
+pytestmark = pytest.mark.rules
+
+
+# compiled-fixture cache: lower+compile is the expensive part, and the
+# drift tests all join against the same two modules
+_COMPILED: dict = {}
+
+
+def _compiled(name):
+    if name not in _COMPILED:
+        b = build_strategy(name)
+        step = b.step if hasattr(b.step, "lower") else jax.jit(b.step)
+        _COMPILED[name] = (b, step.lower(*b.args).compile().as_text())
+    return _COMPILED[name]
+
+
+# ------------------------------------------------------------- coverage
+
+def test_every_contracted_strategy_has_a_ruleset():
+    assert set(RULESETS) == set(STRATEGIES) == set(CONTRACTS)
+    assert ruleset_coverage() == ([], [])
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_ruleset_matches_fixture_trees_clean(strategy):
+    """Every rule-covered step arg of every fixture matches with zero
+    hygiene errors AND zero warnings — no unmatched leaf, no dead rule,
+    no shadowed rule, across all 20 strategies."""
+    build = build_strategy(strategy)
+    rs = RULESETS[strategy]
+    roles = rs.arg_roles
+    assert roles, f"{strategy}: RuleSet covers no step arg at all"
+    for argnum, role in roles.items():
+        report = rs.match(role, build.args[argnum])
+        assert report.ok, f"{strategy}/{role}:\n" + "\n".join(report.errors)
+        assert not report.warnings, (
+            f"{strategy}/{role} dead rules:\n" + "\n".join(report.warnings))
+        if role == "params":              # opt may be empty (plain SGD)
+            assert report.matches, f"{strategy}/params: nothing matched"
+
+
+# -------------------------------------------------------------- hygiene
+
+def _tree():
+    return {"layers": {"w": jnp.ones((8, 4))}, "head": jnp.ones((4,))}
+
+
+def test_seeded_shadowed_rule_errors_with_readable_report():
+    # rule #0 claims everything, so rule #1 matches leaves but claims
+    # none — the report must name both the victim and the shadower
+    rules = (Rule(r".*", ()), Rule(r"^layers/", ("dp",)))
+    report = match_partition_rules(rules, named_leaf_paths(_tree()),
+                                   strategy="seeded")
+    assert not report.ok
+    (err,) = report.errors
+    assert "shadowed rule #1" in err
+    assert "/^layers//" in err and "#0" in err
+    assert "layers/w" in err and "reorder or delete" in err
+
+
+def test_dead_rule_warns():
+    rules = (Rule(r"^nonesuch/", ("dp",)), Rule(r".*", ()))
+    report = match_partition_rules(rules, named_leaf_paths(_tree()))
+    assert report.ok                      # warning, not error
+    (warn,) = report.warnings
+    assert "dead rule #0" in warn and "matches no leaf" in warn
+
+
+def test_unmatched_leaf_errors():
+    report = match_partition_rules((Rule(r"^layers/", ("dp",)),),
+                                   named_leaf_paths(_tree()))
+    assert not report.ok
+    (err,) = report.errors
+    assert "unmatched leaf 'head'" in err
+
+
+def test_first_match_wins_and_describe_names_the_claimer():
+    rules = (Rule(r"^layers/", (None, "dp")), Rule(r".*", ()))
+    report = match_partition_rules(rules, named_leaf_paths(_tree()),
+                                   strategy="demo", role="params")
+    assert report.ok
+    assert report.spec_by_path() == {"layers/w": (None, "dp"),
+                                     "head": ()}
+    dump = report.describe()
+    assert "layers/w" in dump and "rule #0" in dump
+    assert "head" in dump and "rule #1" in dump
+
+
+def test_scalar_leaves_fall_to_replicated_default():
+    tree = {"w": jnp.ones((4, 4)), "count": jnp.zeros(())}
+    report = match_partition_rules((Rule(r"^w$", ("dp",)),),
+                                   named_leaf_paths(tree))
+    assert report.ok
+    by_path = {m.path: m for m in report.matches}
+    assert by_path["count"].spec == () and by_path["count"].rule_index == -1
+    assert by_path["w"].rule_index == 0
+
+
+def test_mirror_opt_rules_prefixes_moment_paths():
+    (cat, spec) = mirror_opt_rules(
+        (Rule(r".*", ("dp",)), Rule(r"^layers/", (None, "dp"))))
+    assert cat.pattern == r"^(mu|nu|momentum)(/|$)"
+    assert spec.pattern == r"^(mu|nu|momentum)/layers/"
+    assert cat.spec == ("dp",) and spec.spec == (None, "dp")
+
+
+def test_tile_dims_resolves_axis_products():
+    sizes = {"dp": 4, "ep": 2}
+    assert tile_dims(("dp",), 2, sizes) == (4, 1)
+    assert tile_dims((None, "dp"), 2, sizes) == (1, 4)
+    assert tile_dims((("dp", "ep"),), 1, sizes) == (8,)
+    assert tile_dims((), 3, sizes) == (1, 1, 1)
+
+
+# ------------------------------------------- generated-vs-hand contracts
+
+def test_generated_contracts_agree_with_hand_registry():
+    """The acceptance bar: the differ runs every strategy over its
+    synthetic context grid and finds zero field-level divergences."""
+    assert set(generate_all_contracts()) == set(CONTRACTS)
+    diffs = diff_all_contracts()
+    assert set(diffs) == set(CONTRACTS)
+    bad = {s: d.divergences for s, d in diffs.items() if not d.ok}
+    assert not bad, f"generated contracts diverge from hand: {bad}"
+
+
+# -------------------------------------------------- compiled drift lint
+
+@pytest.mark.parametrize("strategy", ["ddp", "fsdp"])
+def test_drift_lint_clean_on_compiled_fixture(strategy):
+    build, text = _compiled(strategy)
+    expected, reports = expected_arg_specs(RULESETS[strategy], build.args)
+    assert all(r.ok for r in reports)
+    findings, stats = check_sharding_drift(text, expected, mesh=build.mesh)
+    assert findings == [] and stats["ok"]
+    assert stats["checked"] > 0 and stats["mismatches"] == []
+    assert stats["entry_params"] == stats["expected_leaves"]
+
+
+def test_seeded_drift_violation_fails_with_readable_report():
+    """An all-replicated RuleSet against the genuinely dp-sharded fsdp
+    module: every covered leaf's tiles disagree, and each finding names
+    the parameter, the path, both tilings, and the raw annotation."""
+    build, text = _compiled("fsdp")
+    wrong = dataclasses.replace(
+        RULESETS["fsdp"],
+        param_rules=(Rule(r".*", ()),),
+        opt_rules=mirror_opt_rules((Rule(r".*", ()),)))
+    expected, reports = expected_arg_specs(wrong, build.args)
+    assert all(r.ok for r in reports)     # hygiene fine; placement wrong
+    findings, stats = check_sharding_drift(text, expected, mesh=build.mesh)
+    assert not stats["ok"] and stats["mismatches"]
+    assert all(f.check == "sharding_drift" and f.severity == "error"
+               for f in findings)
+    msg = findings[0].message
+    assert "parameter(" in msg and "tiles" in msg
+    assert "drifted from its declared rules" in msg
+
+
+def test_drift_lint_refuses_misaligned_join():
+    build, text = _compiled("ddp")
+    expected, _ = expected_arg_specs(RULESETS["ddp"], build.args)
+    findings, stats = check_sharding_drift(text, expected[:-1],
+                                           mesh=build.mesh)
+    (f,) = findings
+    assert f.severity == "warn" and "positional join impossible" in f.message
+    assert stats["checked"] == 0
+
+
+# ---------------------------------------------- driver manifest verdict
+
+def test_manifest_verdict_ok_on_live_fixture_params():
+    build, _ = _compiled("fsdp")
+    verdict = rules_manifest_verdict("fsdp", params=build.args[0])
+    assert verdict["ok"] and verdict["checked"] > 0
+    assert verdict["mismatches"] == []
+
+
+def test_manifest_verdict_flags_wrongly_committed_tree():
+    build, _ = _compiled("fsdp")
+    replicated = jax.device_put(
+        build.args[0], NamedSharding(build.mesh, P()))
+    verdict = rules_manifest_verdict("fsdp", params=replicated)
+    assert not verdict["ok"] and verdict["mismatches"]
+    assert "rules derive" in verdict["mismatches"][0]
+
+
+def test_manifest_verdict_unknown_strategy():
+    verdict = rules_manifest_verdict("nonesuch")
+    assert not verdict["ok"] and "no RuleSet" in verdict["error"]
+
+
+# ------------------------------------------------------------- CLI gate
+
+def _main(argv):
+    from scripts.lint_sharding import main
+    return main(argv)
+
+
+def test_cli_rules_and_diff_contracts_pass_on_ddp(tmp_path):
+    out = tmp_path / "report.json"
+    rc = _main(["--cpu-devices", "0", "--strategies", "ddp", "--rules",
+                "--diff-contracts", "--skip-recompile", "--skip-scripts",
+                "--json", str(out)])
+    assert rc == 0
+    rep = json.loads(out.read_text())
+    assert rep["schema_version"] == 2 and rep["ok"] is True
+    r = rep["strategies"]["ddp"]["rules"]
+    assert r["ok"] and r["hygiene_ok"] and r["checked"] > 0
+    dc = rep["diff_contracts"]
+    assert dc["ok"] and dc["strategies"] == len(CONTRACTS)
+    assert dc["divergent"] == {}
+
+
+def test_cli_gate_fails_on_seeded_shadowed_rule(monkeypatch, tmp_path):
+    from distributed_training_sandbox_tpu.analysis import rules as R
+    bad = dataclasses.replace(
+        R.RULESETS["ddp"],
+        param_rules=(Rule(r".*", ()), Rule(r".*", ("dp",))))
+    monkeypatch.setitem(R.RULESETS, "ddp", bad)
+    out = tmp_path / "report.json"
+    rc = _main(["--cpu-devices", "0", "--strategies", "ddp", "--rules",
+                "--skip-recompile", "--skip-scripts", "--skip-compiled",
+                "--json", str(out)])
+    assert rc == 1
+    r = json.loads(out.read_text())["strategies"]["ddp"]["rules"]
+    assert not r["ok"] and not r["hygiene_ok"]
+    assert any("shadowed rule" in e for e in r["errors"])
+
+
+def test_cli_gate_fails_on_seeded_drift(monkeypatch, tmp_path):
+    from distributed_training_sandbox_tpu.analysis import rules as R
+    sharded = (Rule(r".*", ("dp",)),)     # ddp params are replicated
+    bad = dataclasses.replace(
+        R.RULESETS["ddp"], param_rules=sharded,
+        opt_rules=mirror_opt_rules(sharded))
+    monkeypatch.setitem(R.RULESETS, "ddp", bad)
+    out = tmp_path / "report.json"
+    rc = _main(["--cpu-devices", "0", "--strategies", "ddp", "--rules",
+                "--skip-recompile", "--skip-scripts", "--skip-compiled",
+                "--json", str(out)])
+    assert rc == 1
+    r = json.loads(out.read_text())["strategies"]["ddp"]["rules"]
+    assert not r["ok"] and r["hygiene_ok"]     # placement, not hygiene
+    assert r["mismatches"]
