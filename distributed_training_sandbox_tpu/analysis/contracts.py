@@ -415,6 +415,29 @@ CONTRACTS: dict[str, CollectiveContract] = {
         payload_bytes=None,
         description="2 activation psums per (unrolled) layer over tp "
                     "only; the paged kernel adds zero wire sites"),
+    # speculative verify (serving.engine.make_serve_spec_verify_step):
+    # one (B, k+1) target forward replacing k+1 sequential decode steps
+    # — batching over S is slot-local compute, so the choreography is
+    # bitwise serve_decode's (verification is per-row argmax; the
+    # accept/rollback arithmetic runs in a separate collective-free jit)
+    "serve_decode_spec": CollectiveContract(
+        "serve_decode_spec", ("tp",),
+        lambda c: {"all_reduce": 2 * c.n_layers},
+        payload_bytes=None,
+        description="2 activation psums per (unrolled) layer over tp "
+                    "only; the (B, k+1) verify batch adds zero wire "
+                    "sites"),
+    # batched flash prefill (serving.engine.make_serve_prefill_batch_
+    # step): the chunk's attention runs inside the Pallas flash kernel
+    # — pages read in place, online softmax local to the shard's heads
+    # — so again only the layer body's two rejoin psums hit the wire
+    "serve_prefill_flash": CollectiveContract(
+        "serve_prefill_flash", ("tp",),
+        lambda c: {"all_reduce": 2 * c.n_layers},
+        payload_bytes=None,
+        description="2 activation psums per (unrolled) layer over tp "
+                    "only; the flash prefill kernel adds zero wire "
+                    "sites"),
     # pipeline stages are single-device jitted programs; inter-stage comm
     # is host-mediated device transfer, never a mesh collective
     "gpipe": CollectiveContract(

@@ -297,6 +297,77 @@ def _build_serve_decode(strategy: str, *, mesh=None, scale: int = 100,
                          donate=True, full_param_shapes=shapes)
 
 
+@register_strategy("serve_decode_spec", "serve_prefill_flash")
+def _build_serve_frontier(strategy: str, *, mesh=None, scale: int = 100,
+                          seq: int = 32,
+                          batch_size: int = 8) -> StrategyBuild:
+    """The PR-18 serving steps over dp × tp: the speculative (B, k+1)
+    verify forward and the batched flash-kernel prefill chunk.  Both
+    share serve_decode's wire choreography — 2 rejoin psums per
+    unrolled layer over tp, nothing else."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import transformer as T
+    from ..models.generate import _decode_cfg
+    from ..parallel import tensor
+    from ..serving import (PagedKVPool, make_serve_prefill_batch_step,
+                           make_serve_spec_verify_step)
+    from ..utils import make_mesh, set_seed
+    from .hlo_lint import param_shapes
+
+    key = set_seed(0)
+    n_dev = len(jax.devices())
+    mcfg = T.TINY_LM
+    if mesh is None:
+        if n_dev < 4:
+            raise RuntimeError(
+                f"{strategy} fixture needs >= 4 devices "
+                f"(have {n_dev})")
+        mesh = make_mesh({"dp": n_dev // 2, "tp": 2}, register=False)
+    params = T.init_params(key, mcfg)
+    shapes = param_shapes(params, min_numel=1024)
+    ctx = ContractContext.capture(params=params, mesh=mesh,
+                                  n_layers=mcfg.num_hidden_layers)
+    shards = tensor.shard_params_tp(params, mesh)
+    page_size, pages_per = 8, 4
+    pool = PagedKVPool(_decode_cfg(mcfg),
+                       batch_size * pages_per + 1, page_size,
+                       mesh=mesh)
+    pages = jnp.asarray(np.arange(
+        1, batch_size * pages_per + 1,
+        dtype=np.int32).reshape(batch_size, pages_per))
+    if strategy == "serve_decode_spec":
+        k = 3
+        step = make_serve_spec_verify_step(
+            mcfg, shards, mesh=mesh, pool_spec=pool.spec)
+        args = (pool.bufs, shards, pages,
+                jnp.zeros((batch_size, k + 1), jnp.int32),  # proposals
+                jnp.zeros((batch_size,), jnp.int32),        # lengths
+                jnp.full((batch_size,), page_size * pages_per - 1,
+                         jnp.int32),                        # stop_at
+                jnp.ones((batch_size,), bool))              # active
+        # outputs: (greedy, bufs, occ) — the host accept/rollback jit
+        # sits between bursts, so the fixture just recycles the pool
+        advance = lambda args, out: (out[1],) + args[1:]
+    else:
+        chunk = 16
+        step = make_serve_prefill_batch_step(
+            mcfg, shards, mesh=mesh, pool_spec=pool.spec,
+            flash_prefill=True)
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            1, mcfg.vocab_size, size=(batch_size, chunk),
+            dtype=np.int32))
+        args = (pool.bufs, shards, pages, ids,
+                jnp.zeros((batch_size,), jnp.int32),        # chunk pos
+                jnp.full((batch_size,), chunk, jnp.int32))  # prompt len
+        # outputs: (first_tok, bufs)
+        advance = lambda args, out: (out[1],) + args[1:]
+    return StrategyBuild(strategy, step, args, advance, mesh, ctx,
+                         donate=True, full_param_shapes=shapes)
+
+
 @register_strategy("gpipe", "1f1b")
 def _build_pipeline(strategy: str, *, mesh=None, scale: int = 100,
                     seq: int = 32,

@@ -446,17 +446,23 @@ def moe_ruleset(strategy: str, *, axis: str = "ep",
 
 
 def serve_ruleset(strategy: str, *, axis: str = "tp",
-                  paged_kernel: bool = False) -> RuleSet:
-    """Serving decode: tp-sharded weights at rest, inference only (no
-    opt state; the KV pool and request vectors ride their own specs
-    outside the rule universe)."""
+                  paged_kernel: bool = False,
+                  step: str = "decode") -> RuleSet:
+    """Serving inference steps: tp-sharded weights at rest, inference
+    only (no opt state; the KV pool and request vectors ride their own
+    specs outside the rule universe).  ``step`` names which engine step
+    the strategy lowers — "decode" (one token per slot), "spec_verify"
+    (the (B, k+1) speculative verify), or "prefill_flash" (the batched
+    flash-kernel prefill chunk) — all three share the family's wire
+    choreography: the layer body's two rejoin psums, unrolled over
+    depth."""
     base = tp_ruleset(strategy, axis=axis)
     return RuleSet(
         strategy=strategy, family="serve", axes=(axis,),
         param_rules=base.param_rules,
         weight_update_sharding=0,
-        config={"paged_kernel": paged_kernel},
-        description="serving decode over tp"
+        config={"paged_kernel": paged_kernel, "step": step},
+        description=f"serving {step} over tp"
                     + (", paged-attention kernel" if paged_kernel else ""))
 
 
@@ -495,6 +501,10 @@ RULESETS: dict[str, RuleSet] = {
     "serve_decode": serve_ruleset("serve_decode"),
     "serve_decode_paged_kernel": serve_ruleset(
         "serve_decode_paged_kernel", paged_kernel=True),
+    "serve_decode_spec": serve_ruleset(
+        "serve_decode_spec", step="spec_verify"),
+    "serve_prefill_flash": serve_ruleset(
+        "serve_prefill_flash", paged_kernel=True, step="prefill_flash"),
     "gpipe": pipeline_ruleset("gpipe"),
     "1f1b": pipeline_ruleset("1f1b"),
 }

@@ -16,19 +16,24 @@ Poisson-traffic SLO report (``--replicas N`` for the fleet).
 from .accounting import (kv_bytes_per_step, page_bytes,
                          pool_capacity_pages, serve_waterline_gb,
                          weight_read_bytes)
-from .engine import (ServingEngine, make_serve_decode_step,
-                     make_serve_prefill_step, serve)
+from .engine import (ServingEngine, make_draft_params,
+                     make_serve_decode_step,
+                     make_serve_prefill_batch_step,
+                     make_serve_prefill_step,
+                     make_serve_spec_verify_step, serve)
 from .fleet import Fleet, Replica
-from .kv_pool import PageAllocator, PagedKVPool, PoolBuffers
+from .kv_pool import (PageAllocator, PagedKVPool, PoolBuffers,
+                      RadixPrefixCache)
 from .router import AdmissionController, Rejection, Router
 from .scheduler import ContinuousBatcher, Request, reset_for_replay
 
 __all__ = [
     "ServingEngine", "serve", "make_serve_decode_step",
-    "make_serve_prefill_step",
+    "make_serve_prefill_step", "make_serve_prefill_batch_step",
+    "make_serve_spec_verify_step", "make_draft_params",
     "Fleet", "Replica",
     "AdmissionController", "Rejection", "Router",
-    "PagedKVPool", "PageAllocator", "PoolBuffers",
+    "PagedKVPool", "PageAllocator", "PoolBuffers", "RadixPrefixCache",
     "ContinuousBatcher", "Request", "reset_for_replay",
     "kv_bytes_per_step", "weight_read_bytes", "page_bytes",
     "serve_waterline_gb", "pool_capacity_pages",

@@ -58,26 +58,50 @@ def page_bytes(cfg, page_size: int, *, kv_quant: bool = False,
 
 def serve_waterline_gb(cfg, n_pages: int, page_size: int, *,
                        weight_bytes: int = 0, kv_quant: bool = False,
-                       tp: int = 1) -> float:
+                       tp: int = 1, draft_weight_bytes: int = 0,
+                       draft_cfg=None) -> float:
     """Static serving HBM waterline: resident weights + the paged KV
     pool.  Decode-step activations are a few (B, 1, H) rows — noise next
     to these two, so they are the whole ledger (the serving counterpart
-    of ``memory_plan.analytic_waterline``'s train-side terms)."""
+    of ``memory_plan.analytic_waterline``'s train-side terms).
+
+    Speculative decoding adds two resident terms: the draft model's
+    weights, and the draft's OWN paged pool — the draft pool mirrors the
+    target's page table 1:1 (same ``n_pages``, same ``page_size``, the
+    draft cfg's shallower layer stack), so its bytes scale with the same
+    page count.  Prefix sharing adds nothing here: aliased pages are the
+    same physical pages, refcounts are host-side metadata — the waterline
+    is a function of pool CAPACITY, not of how requests share it."""
     pool = n_pages * page_bytes(cfg, page_size, kv_quant=kv_quant, tp=tp)
-    return (weight_bytes + pool) / GB
+    if draft_cfg is not None:
+        pool += n_pages * page_bytes(draft_cfg, page_size,
+                                     kv_quant=kv_quant, tp=tp)
+    return (weight_bytes + draft_weight_bytes + pool) / GB
 
 
 def pool_capacity_pages(cfg, page_size: int, *, budget_gb: float,
                         weight_bytes: int = 0, kv_quant: bool = False,
                         tp: int = 1,
-                        headroom_fraction: float = 0.10) -> int:
+                        headroom_fraction: float = 0.10,
+                        draft_weight_bytes: int = 0,
+                        draft_cfg=None) -> int:
     """Pages that fit ``budget_gb`` once the weights are resident, with
     ``headroom_fraction`` of the budget held back for the decode step's
     working set and allocator slack — the pool-sizing inverse of
     :func:`serve_waterline_gb`.  Returns 0 when the weights alone
-    exceed the usable budget (the caller should refuse to serve)."""
-    usable = budget_gb * GB * (1.0 - headroom_fraction) - weight_bytes
+    exceed the usable budget (the caller should refuse to serve).
+
+    With a draft model resident (speculative decoding) the draft's
+    weights come off the top and each page's marginal cost is the
+    target page PLUS its draft-pool twin, keeping the inverse exact:
+    ``serve_waterline_gb(cfg, N, p, ..., draft_cfg=d)`` at the returned
+    N stays within budget."""
+    usable = budget_gb * GB * (1.0 - headroom_fraction) \
+        - weight_bytes - draft_weight_bytes
     if usable <= 0:
         return 0
-    return int(usable // page_bytes(cfg, page_size, kv_quant=kv_quant,
-                                    tp=tp))
+    per_page = page_bytes(cfg, page_size, kv_quant=kv_quant, tp=tp)
+    if draft_cfg is not None:
+        per_page += page_bytes(draft_cfg, page_size, kv_quant=kv_quant,
+                               tp=tp)
+    return int(usable // per_page)

@@ -40,6 +40,7 @@ future work).
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import time
 from functools import partial
@@ -51,11 +52,12 @@ import numpy as np
 from ..models import transformer as T
 from ..models.generate import _decode_cfg, _quant_kv
 from ..ops import collectives as C
-from .kv_pool import PagedKVPool, PoolBuffers
+from .kv_pool import PagedKVPool, PoolBuffers, RadixPrefixCache
 from .scheduler import ContinuousBatcher, DECODE, PREFILL, Request
 
 __all__ = ["ServingEngine", "serve", "make_serve_decode_step",
-           "make_serve_prefill_step"]
+           "make_serve_prefill_step", "make_serve_spec_verify_step",
+           "make_serve_prefill_batch_step", "make_draft_params"]
 
 
 # ---------------------------------------------------------------- layer math
@@ -86,7 +88,7 @@ def _apply_rope_ragged(x, cos, sin):
 
 def _paged_layer_body(x, layer, *, cfg, cos, sin, use_rope, pk, pv,
                       pk_s, pv_s, pages, apos, valid, tp_axis=None,
-                      paged_kernel=False):
+                      paged_kernel=False, flash_prefill=False):
     """One decoder layer against the PAGED pool — the numerics of
     ``generate._cached_layer_body`` with scatter/gather storage:
 
@@ -164,6 +166,28 @@ def _paged_layer_body(x, layer, *, cfg, cos, sin, use_rope, pk, pv,
             mlp = C.all_reduce(mlp, tp_axis)
         return x + mlp, (pk, pv, pk_s, pv_s)
 
+    if flash_prefill and S > 1 and not quantized:
+        # Pallas flash prefill: the whole chunk's attention in one
+        # tiled online-softmax kernel reading pages via the table — no
+        # (B, V, nkv, hd) gather view.  Single-tile (the default) is
+        # bitwise-equal to the gather+einsum path below
+        # (ops/flash_prefill.py pins the epilogue ordering).
+        from ..ops.flash_prefill import paged_flash_prefill
+        rep = nq // nkv
+        qg = q.reshape(B, S, nkv, rep, hd)
+        attn = paged_flash_prefill(qg, pk, pv, pages, apos,
+                                   probs_dtype=x.dtype)
+        attn = attn.astype(x.dtype).reshape(B, S, nq * hd)
+        attn_out = dense(attn, layer["wo"])
+        if tp_axis:
+            attn_out = C.all_reduce(attn_out, tp_axis)
+        x = x + attn_out
+        r = T.rms_norm(x, layer["ln2"], cfg.rms_norm_eps)
+        mlp, _aux = T._mlp_block(r, layer, cfg=cfg)
+        if tp_axis:
+            mlp = C.all_reduce(mlp, tp_axis)
+        return x + mlp, (pk, pv, pk_s, pv_s)
+
     # gather the slot's pages into the contiguous head-major view the
     # attention contracts over — fixed extent V for every request, the
     # parity-bearing choice (see module docstring)
@@ -213,7 +237,8 @@ def _paged_layer_body(x, layer, *, cfg, cos, sin, use_rope, pk, pv,
 
 
 def _paged_forward(params, ids, cfg, bufs: PoolBuffers, pages, apos,
-                   valid, tp_axis=None, paged_kernel=False):
+                   valid, tp_axis=None, paged_kernel=False,
+                   flash_prefill=False):
     """ids (B, S) → (hidden x (B, S, H), bufs') through the UNROLLED
     layer stack (static layer index into the per-layer pools, like
     ``generate._forward_cached``)."""
@@ -234,7 +259,7 @@ def _paged_forward(params, ids, cfg, bufs: PoolBuffers, pages, apos,
             pk_s=kss[li] if kss is not None else None,
             pv_s=vss[li] if vss is not None else None,
             pages=pages, apos=apos, valid=valid, tp_axis=tp_axis,
-            paged_kernel=paged_kernel)
+            paged_kernel=paged_kernel, flash_prefill=flash_prefill)
         if kss is not None:
             kss[li], vss[li] = ksc, vsc
     out = PoolBuffers(k=tuple(ks), v=tuple(vs),
@@ -243,17 +268,26 @@ def _paged_forward(params, ids, cfg, bufs: PoolBuffers, pages, apos,
     return x, out
 
 
-def _last_logits(params, x_last, cfg):
-    """(B, 1, H) hidden → (B, vocab) fp32 logits, same tail as
-    ``generate._forward_cached``."""
-    x = T.rms_norm(x_last, params["final_norm"], cfg.rms_norm_eps)
+def _all_logits(params, x, cfg):
+    """(B, S, H) hidden → (B, S, vocab) fp32 logits: the
+    ``generate._forward_cached`` tail at EVERY row.  rms_norm and the
+    unembedding are per-row ops, so row ``i`` is bitwise the
+    single-position tail evaluated at that position — what lets the
+    speculative verify step read k+1 greedy tokens from one forward."""
+    x = T.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     uq = params.get("unembed_q")
     if uq is not None:
         from ..ops.quant import prequantized_dense
-        logits = prequantized_dense(x, uq)[:, 0]
+        logits = prequantized_dense(x, uq)
     else:
-        logits = (x @ T._output_embedding(params, cfg).T)[:, 0]
+        logits = x @ T._output_embedding(params, cfg).T
     return logits.astype(jnp.float32)
+
+
+def _last_logits(params, x_last, cfg):
+    """(B, 1, H) hidden → (B, vocab) fp32 logits, same tail as
+    ``generate._forward_cached``."""
+    return _all_logits(params, x_last, cfg)[:, 0]
 
 
 def _decode_core(bufs, params, pages, toks, lengths, stop_at, active, *,
@@ -294,6 +328,77 @@ def _prefill_core(bufs, params, pages_row, ids, pos, plen, *, cfg,
     logits = _last_logits(params, xl, cfg)
     tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return tok, bufs
+
+
+def _prefill_batch_core(bufs, params, pages, ids, pos, plen, *, cfg,
+                        tp_axis=None, flash_prefill=False):
+    """One prefill chunk for a BATCH of requests: ids (Bp, C), pages
+    (Bp, P), pos/plen (Bp,) int32 — the multi-request prefill step.
+    Pad rows carry ``plen == 0``: every position is invalid, scatters
+    divert to the null page, and the (garbage) token output is never
+    read.  Returns each row's greedy token at its final prompt position
+    — meaningful only for rows whose final chunk this is.  Rows are
+    per-request bitwise-independent (the parity invariant), so batching
+    requests changes nothing a single-row prefill would emit."""
+    Bp, Ck = ids.shape
+    apos = pos[:, None] + jnp.arange(Ck, dtype=jnp.int32)[None, :]
+    valid = apos < plen[:, None]
+    x, bufs = _paged_forward(params, ids, cfg, bufs, pages, apos, valid,
+                             tp_axis=tp_axis,
+                             flash_prefill=flash_prefill)
+    last = jnp.clip(plen - 1 - pos, 0, Ck - 1)
+    xl = jnp.take_along_axis(x, last[:, None, None], axis=1)
+    logits = _last_logits(params, xl, cfg)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return tok, bufs
+
+
+def _spec_verify_core(bufs, params, pages, toks_blk, lengths, stop_at,
+                      active, *, cfg, tp_axis=None):
+    """The speculative VERIFY step: one fixed-shape target forward over
+    a (B, k+1) token block per slot — the last accepted token plus the
+    draft's k proposals.  Row ``i`` writes its K/V at ``lengths + i``
+    (scatter precedes the gather inside every layer, so each row
+    attends over exactly the committed prefix plus proposal rows
+    ``<= i`` — the same visible set a sequential greedy decode would
+    see, hence bitwise-identical per-row logits at temperature 0).
+    Rows at positions ``>= stop_at`` divert to the null page: the
+    device can never write past a request's page grant, mirroring the
+    vanilla step's on-device auto-retire.  Returns per-row greedy
+    argmax (B, k+1); acceptance is a separate collective-free jit
+    (:func:`_spec_accept_core`) so macro-steps chain without a host
+    sync."""
+    B, S = toks_blk.shape
+    apos = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    valid = active[:, None] & (apos < stop_at[:, None])
+    x, bufs = _paged_forward(params, toks_blk, cfg, bufs, pages, apos,
+                             valid, tp_axis=tp_axis)
+    greedy = jnp.argmax(_all_logits(params, x, cfg),
+                        axis=-1).astype(jnp.int32)
+    occ = jnp.sum(active.astype(jnp.int32))
+    return greedy, bufs, occ
+
+
+def _spec_accept_core(toks_blk, greedy, toks, lengths, stop_at, active):
+    """Device-side acceptance: longest verified prefix per slot.  Draft
+    proposal ``toks_blk[:, i+1]`` is accepted iff it equals the
+    target's greedy continuation ``greedy[:, i]`` and every earlier
+    proposal matched — so the emitted stream ``greedy[:, :e]`` is
+    exactly what sequential greedy decode would have produced (the
+    rejected tail's pool rows are dead weight the next macro-step
+    overwrites).  ``e`` is capped at ``stop_at - lengths`` so a slot
+    never emits past its budget; inactive slots freeze with e = 0."""
+    k = toks_blk.shape[1] - 1
+    match = (toks_blk[:, 1:] == greedy[:, :-1]).astype(jnp.int32)
+    e = 1 + jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+    e = jnp.minimum(e, stop_at - lengths)
+    e = jnp.where(active, e, 0).astype(jnp.int32)
+    new_len = lengths + e
+    new_active = jnp.logical_and(active, new_len < stop_at)
+    idx = jnp.clip(e - 1, 0, k)
+    nxt = jnp.take_along_axis(greedy, idx[:, None], axis=1)[:, 0]
+    nxt = jnp.where(active, nxt, toks).astype(jnp.int32)
+    return nxt, new_len, new_active, e
 
 
 # ------------------------------------------------------------- step builders
@@ -341,6 +446,68 @@ def make_serve_prefill_step(cfg, params=None, *, mesh=None,
                           out_specs=out_specs), donate_argnums=(0,))
 
 
+def make_serve_prefill_batch_step(cfg, params=None, *, mesh=None,
+                                  tp_axis: str = "tp", pool_spec=None,
+                                  flash_prefill: bool = True):
+    """The jitted BATCHED multi-request prefill-chunk step (see
+    :func:`_prefill_batch_core`).  ``flash_prefill`` routes the chunk's
+    attention through the Pallas flash kernel
+    (``ops/flash_prefill.py``) instead of the gather+einsum path —
+    bitwise-equal in the default single-tile mode."""
+    cfg = _decode_cfg(cfg)
+    if mesh is None:
+        return jax.jit(partial(_prefill_batch_core, cfg=cfg,
+                               tp_axis=None,
+                               flash_prefill=flash_prefill),
+                       donate_argnums=(0,))
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.tensor import tp_specs
+    core = partial(_prefill_batch_core, cfg=cfg, tp_axis=tp_axis,
+                   flash_prefill=flash_prefill)
+    in_specs = (pool_spec, tp_specs(params, tp_axis), P(), P(), P(), P())
+    out_specs = (P(), pool_spec)
+    return jax.jit(C.smap(core, mesh, in_specs=in_specs,
+                          out_specs=out_specs), donate_argnums=(0,))
+
+
+def make_serve_spec_verify_step(cfg, params=None, *, mesh=None,
+                                tp_axis: str = "tp", pool_spec=None):
+    """The jitted speculative-verify step (see
+    :func:`_spec_verify_core`): one (B, k+1) target forward replaces
+    k+1 sequential decode steps.  Same collective shape as the decode
+    step — 2 psums per layer over ``tp`` — which is the
+    ``serve_decode_spec`` contract."""
+    cfg = _decode_cfg(cfg)
+    if mesh is None:
+        return jax.jit(partial(_spec_verify_core, cfg=cfg,
+                               tp_axis=None), donate_argnums=(0,))
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.tensor import tp_specs
+    core = partial(_spec_verify_core, cfg=cfg, tp_axis=tp_axis)
+    in_specs = (pool_spec, tp_specs(params, tp_axis), P(), P(), P(),
+                P(), P())
+    out_specs = (P(), pool_spec, P())
+    return jax.jit(C.smap(core, mesh, in_specs=in_specs,
+                          out_specs=out_specs), donate_argnums=(0,))
+
+
+def make_draft_params(params, cfg, n_layers: int):
+    """A correlated toy draft model: the target's first ``n_layers``
+    decoder layers with the embedding / final-norm / unembedding kept.
+    Cheap to run, right often enough on easy tokens to be a useful
+    proposer — and parity never depends on it: at temperature 0 ANY
+    draft yields the vanilla greedy stream, a bad one just lowers the
+    acceptance rate.  Returns ``(draft_params, draft_cfg)``."""
+    if not 1 <= int(n_layers) <= cfg.num_hidden_layers:
+        raise ValueError(f"draft of {n_layers} layers from a "
+                         f"{cfg.num_hidden_layers}-layer target")
+    draft = dict(params)
+    draft["layers"] = jax.tree.map(lambda p: p[:int(n_layers)],
+                                   params["layers"])
+    return draft, dataclasses.replace(cfg,
+                                      num_hidden_layers=int(n_layers))
+
+
 # ------------------------------------------------------------------- engine
 
 class ServingEngine:
@@ -363,6 +530,10 @@ class ServingEngine:
                  sync_every: int = 4, max_in_flight: int = 8,
                  kv_quant: bool = False,
                  paged_kernel: bool = False,
+                 prefix_cache: bool = False,
+                 spec_k: int = 0, draft_params=None, draft_cfg=None,
+                 draft_layers: int | None = None,
+                 flash_prefill: bool = False,
                  hbm_budget_gb: float | None = None,
                  disaggregate: bool = False, device=None,
                  watchdog=None, telem=None):
@@ -382,6 +553,35 @@ class ServingEngine:
         # in place via the table — ops/paged_attention.py); prefill
         # (S > 1) keeps the gather path
         self.paged_kernel = bool(paged_kernel)
+        # prefill through the BATCHED multi-request step with the
+        # Pallas flash-attention kernel (ops/flash_prefill.py)
+        self.flash_prefill = bool(flash_prefill)
+        self.spec_k = int(spec_k)
+        if self.flash_prefill and kv_quant:
+            raise ValueError("the flash prefill kernel is float-only — "
+                             "drop kv_quant or flash_prefill")
+        if prefix_cache and disaggregate:
+            raise ValueError(
+                "prefix_cache aliases decode-pool pages across "
+                "requests; the disaggregated handoff injects full page "
+                "rows and would overwrite shared pages — not wired")
+        if self.spec_k and disaggregate:
+            raise ValueError("speculative decoding needs a resident "
+                             "draft pool; the disaggregated handoff is "
+                             "not wired for it")
+        if self.spec_k:
+            if draft_params is None:
+                if draft_layers is None:
+                    raise ValueError(
+                        "spec_k > 0 needs draft_params + draft_cfg, or "
+                        "draft_layers to truncate the target")
+                draft_params, draft_cfg = make_draft_params(
+                    params, self.cfg, draft_layers)
+            elif draft_cfg is None:
+                raise ValueError("draft_params needs draft_cfg")
+            self.draft_cfg = _decode_cfg(draft_cfg)
+        else:
+            self.draft_cfg = None
         self.mesh = mesh
         self.tp_axis = tp_axis if mesh is not None else None
         self.telem = telem
@@ -413,6 +613,10 @@ class ServingEngine:
                                  "params (int8 weight sharding is not "
                                  "wired)")
             params = shard_params_tp(params, mesh, tp_axis)
+            if self.spec_k:
+                check_tp_divisibility(self.draft_cfg, tp)
+                draft_params = shard_params_tp(draft_params, mesh,
+                                               tp_axis)
 
         if n_pages is None:
             n_pages = self.max_batch * self.pages_per_request + 1
@@ -422,7 +626,10 @@ class ServingEngine:
                 fit = pool_capacity_pages(
                     self.cfg, self.page_size, budget_gb=hbm_budget_gb,
                     weight_bytes=tree_size_bytes(params),
-                    kv_quant=self.kv_quant, tp=tp) + 1
+                    kv_quant=self.kv_quant, tp=tp,
+                    draft_weight_bytes=(tree_size_bytes(draft_params)
+                                        if self.spec_k else 0),
+                    draft_cfg=self.draft_cfg) + 1
                 n_pages = min(n_pages, fit)
         if n_pages < self.pages_per_request + 1:
             raise ValueError(
@@ -444,6 +651,8 @@ class ServingEngine:
             self._prefill_dev = self._decode_dev = device
             self._params = self._params_pre = jax.device_put(params,
                                                              device)
+            if self.spec_k:
+                draft_params = jax.device_put(draft_params, device)
         elif self.disaggregate:
             if len(devs) < 2:
                 raise ValueError("disaggregate needs >= 2 devices")
@@ -454,10 +663,23 @@ class ServingEngine:
         else:
             self._params = params
             self._params_pre = params
+        self._draft_params = draft_params if self.spec_k else None
 
         self.pool = PagedKVPool(self.cfg, self.n_pages, self.page_size,
                                 kv_quant=self.kv_quant, mesh=mesh,
                                 tp_axis=tp_axis, device=self._decode_dev)
+        # the draft model's own pool, addressed by the SAME page tables
+        # as the target pool (no second allocator): position p of a
+        # request's draft KV lives at the same (page, offset) as its
+        # target KV, so admission/eviction/prefix-alias bookkeeping is
+        # shared and the draft rows for a trie-cached page stay valid
+        # exactly as long as the page is cached
+        self.draft_pool = None
+        if self.spec_k:
+            self.draft_pool = PagedKVPool(
+                self.draft_cfg, self.n_pages, self.page_size,
+                kv_quant=self.kv_quant, mesh=mesh, tp_axis=tp_axis,
+                device=self._decode_dev)
         # the serving-side waterline prediction the memory ledger joins:
         # accounting's weights+pool model vs the decode program's own
         # memory_analysis() (attached at the first decode burst)
@@ -465,13 +687,20 @@ class ServingEngine:
         from .accounting import serve_waterline_gb
         _wb = tree_size_bytes(self._params)
         _pool_b = tree_size_bytes(self.pool.bufs)
+        _dwb = tree_size_bytes(self._draft_params) if self.spec_k else 0
+        comps = {"weights": round(_wb / GB, 3),
+                 "kv_pool": round(_pool_b / GB, 3)}
+        if self.spec_k:
+            comps["draft_weights"] = round(_dwb / GB, 3)
+            comps["draft_kv_pool"] = round(
+                tree_size_bytes(self.draft_pool.bufs) / GB, 3)
         self._mem_prediction = {
             "predicted_gb": round(serve_waterline_gb(
                 self.cfg, self.n_pages, self.page_size, weight_bytes=_wb,
-                kv_quant=self.kv_quant, tp=tp), 3),
+                kv_quant=self.kv_quant, tp=tp,
+                draft_weight_bytes=_dwb, draft_cfg=self.draft_cfg), 3),
             "source": "serve_accounting",
-            "components": {"weights": round(_wb / GB, 3),
-                           "kv_pool": round(_pool_b / GB, 3)},
+            "components": comps,
         }
         self.pool_pre = None
         if self.disaggregate:
@@ -480,13 +709,39 @@ class ServingEngine:
                 kv_quant=self.kv_quant, device=self._prefill_dev)
             self._pre_pages: dict[int, list[int]] = {}
 
+        pool_spec = self.pool.spec if mesh is not None else None
         self._decode = make_serve_decode_step(
             self.cfg, self._params, mesh=mesh, tp_axis=tp_axis,
-            pool_spec=self.pool.spec if mesh is not None else None,
-            paged_kernel=self.paged_kernel)
-        self._prefill = make_serve_prefill_step(
-            self.cfg, self._params_pre, mesh=mesh, tp_axis=tp_axis,
-            pool_spec=self.pool.spec if mesh is not None else None)
+            pool_spec=pool_spec, paged_kernel=self.paged_kernel)
+        self._prefill = self._prefill_batch = None
+        if self.flash_prefill:
+            self._prefill_batch = make_serve_prefill_batch_step(
+                self.cfg, self._params_pre, mesh=mesh, tp_axis=tp_axis,
+                pool_spec=pool_spec, flash_prefill=True)
+        else:
+            self._prefill = make_serve_prefill_step(
+                self.cfg, self._params_pre, mesh=mesh, tp_axis=tp_axis,
+                pool_spec=pool_spec)
+        self._draft_decode = self._verify = self._accept = None
+        self._draft_prefill = self._draft_prefill_batch = None
+        if self.spec_k:
+            dspec = self.draft_pool.spec if mesh is not None else None
+            self._draft_decode = make_serve_decode_step(
+                self.draft_cfg, self._draft_params, mesh=mesh,
+                tp_axis=tp_axis, pool_spec=dspec,
+                paged_kernel=self.paged_kernel)
+            self._verify = make_serve_spec_verify_step(
+                self.cfg, self._params, mesh=mesh, tp_axis=tp_axis,
+                pool_spec=pool_spec)
+            self._accept = jax.jit(_spec_accept_core)
+            if self.flash_prefill:
+                self._draft_prefill_batch = make_serve_prefill_batch_step(
+                    self.draft_cfg, self._draft_params, mesh=mesh,
+                    tp_axis=tp_axis, pool_spec=dspec, flash_prefill=True)
+            else:
+                self._draft_prefill = make_serve_prefill_step(
+                    self.draft_cfg, self._draft_params, mesh=mesh,
+                    tp_axis=tp_axis, pool_spec=dspec)
         if self.disaggregate:
             # KV handoff: gather the request's page blocks out of the
             # prefill pool, ship, scatter into its decode pages.  Full
@@ -529,6 +784,11 @@ class ServingEngine:
                                          self.pool.allocator,
                                          self.page_size)
         self.batcher.metrics = getattr(telem, "metrics", None)
+        self.prefix_cache = None
+        if prefix_cache:
+            self.prefix_cache = RadixPrefixCache(self.pool.allocator,
+                                                 self.page_size)
+            self.batcher.prefix_cache = self.prefix_cache
         self._pending: list[Request] = []
         self.completed: list[Request] = []
         self._rid = 0
@@ -538,7 +798,9 @@ class ServingEngine:
         self.stats = {"rounds": 0, "decode_steps": 0, "prefill_chunks": 0,
                       "admit_s": 0.0, "bookkeep_s": 0.0,
                       "occupancy_sum": 0, "peak_pool_util": 0.0,
-                      "wall_s": 0.0, "host_sync_count": 0}
+                      "wall_s": 0.0, "host_sync_count": 0,
+                      "draft_steps": 0, "spec_proposed": 0,
+                      "spec_accepted": 0}
 
     # ---- request intake ----------------------------------------------
     def submit(self, prompt, max_new_tokens: int,
@@ -626,6 +888,15 @@ class ServingEngine:
             self.pool_pre.bufs = bufs
         else:
             self.pool.bufs = bufs
+        if self.spec_k:
+            # the draft needs the prompt's KV in ITS pool to propose —
+            # ride the same chunk schedule (same pages, draft params)
+            _dtok, dbufs = self._draft_prefill(
+                self.draft_pool.bufs, self._draft_params,
+                self._put(row, dev), self._put(ids, dev),
+                self._put(np.int32(pos), dev),
+                self._put(np.int32(req.n_prompt), dev))
+            self.draft_pool.bufs = dbufs
         req.prefill_pos = min(pos + Ck, req.n_prompt)
         self.stats["prefill_chunks"] += 1
         if req.prefill_pos < req.n_prompt:
@@ -644,7 +915,27 @@ class ServingEngine:
             self.pool_pre.allocator.free(self._pre_pages.pop(req.rid))
         first = int(np.asarray(tok_d)[0])   # sync-ok: TTFT resolution
         self.stats["host_sync_count"] += 1
+        self._finish_prefill(req, first, t_chunk, t0)
+
+    def _finish_prefill(self, req: Request, first: int, t_chunk: float,
+                        t0: float) -> None:
+        """Shared final-chunk bookkeeping: donate full-prompt pages to
+        the prefix cache, stamp TTFT, emit telemetry, and flip the slot
+        into DECODE (or retire it when ``max_new == 1``)."""
         now = time.perf_counter() - t0
+        if self.prefix_cache is not None:
+            # insert at prefill COMPLETION: the request's full prompt
+            # pages hold committed KV now, so later arrivals sharing
+            # the prefix alias them.  A concurrent twin that finished
+            # first wins the trie slot — our duplicate page is freed
+            # and the page-table entry swaps to the cached twin
+            # (bitwise-identical content, invisible to decode).
+            nodes, swaps = self.prefix_cache.insert(
+                req.prompt, req.pages, req.cache_nodes)
+            req.cache_nodes = nodes
+            for i, pg in swaps.items():
+                req.pages[i] = pg
+                self._h_pages[req.slot, i] = pg
         req.tokens.append(first)
         req.t_first = now
         prefill_s = time.perf_counter() - t_chunk
@@ -682,6 +973,71 @@ class ServingEngine:
         self._h_lengths[b] = req.n_prompt
         self._h_stop[b] = stop
         self._h_active[b] = True
+
+    def _prefill_batch_chunk(self, reqs: list[Request],
+                             t0: float) -> None:
+        """One BATCHED prefill chunk: every in-flight PREFILL request
+        advances one chunk through a single fixed-shape
+        (max_batch, C) step — the multi-request prefill the flash
+        kernel tier serves.  Pad rows carry ``plen = 0`` (every
+        position invalid); requests whose final chunk this is resolve
+        their first token in ONE host sync."""
+        B, Ck = self.max_batch, self.prefill_chunk
+        ids = np.zeros((B, Ck), np.int32)
+        pages = np.zeros((B, self.pages_per_request), np.int32)
+        pos = np.zeros(B, np.int32)
+        plen = np.zeros(B, np.int32)
+        for i, req in enumerate(reqs):
+            chunk = req.prompt[req.prefill_pos:req.prefill_pos + Ck]
+            ids[i, :chunk.shape[0]] = chunk
+            src = (self._pre_pages[req.rid] if self.disaggregate
+                   else req.pages)
+            pages[i, :len(src)] = src
+            pos[i] = req.prefill_pos
+            plen[i] = req.n_prompt
+        dev = self._prefill_dev
+        bufs = self.pool_pre.bufs if self.disaggregate \
+            else self.pool.bufs
+        t_chunk = time.perf_counter()
+        tok_d, bufs = self._prefill_batch(
+            bufs, self._params_pre, self._put(pages, dev),
+            self._put(ids, dev), self._put(pos, dev),
+            self._put(plen, dev))
+        if self.disaggregate:
+            self.pool_pre.bufs = bufs
+        else:
+            self.pool.bufs = bufs
+        if self.spec_k:
+            _dt, dbufs = self._draft_prefill_batch(
+                self.draft_pool.bufs, self._draft_params,
+                self._put(pages, dev), self._put(ids, dev),
+                self._put(pos, dev), self._put(plen, dev))
+            self.draft_pool.bufs = dbufs
+        self.stats["prefill_chunks"] += 1
+        finishing = []
+        for i, req in enumerate(reqs):
+            req.prefill_pos = min(req.prefill_pos + Ck, req.n_prompt)
+            if req.prefill_pos >= req.n_prompt:
+                finishing.append((i, req))
+        if not finishing:
+            return
+        if self.disaggregate:
+            for i, req in finishing:
+                row = self._padded_row(self._pre_pages[req.rid])
+                dec_row = self._padded_row(req.pages)
+                blocks = self._extract(
+                    self.pool_pre.bufs,
+                    self._put(row[0], self._prefill_dev))
+                blocks = jax.device_put(blocks, self._decode_dev)
+                self.pool.bufs = self._inject(
+                    self.pool.bufs, blocks,
+                    self._put(dec_row[0], self._decode_dev))
+                self.pool_pre.allocator.free(
+                    self._pre_pages.pop(req.rid))
+        toks = np.asarray(tok_d)    # sync-ok: TTFT resolution, one
+        self.stats["host_sync_count"] += 1   # sync for all finishers
+        for i, req in finishing:
+            self._finish_prefill(req, int(toks[i]), t_chunk, t0)
 
     # ---- decode -------------------------------------------------------
     def _decode_burst(self, pump, t0: float) -> None:
@@ -774,6 +1130,131 @@ class ServingEngine:
                                            3),
                      "tokens": len(r.tokens)} for r in finished])
 
+    def _spec_burst(self, pump, t0: float) -> None:
+        """Speculative decode burst: ``sync_every`` macro-steps, each =
+        k draft decode steps + one (B, k+1) target verify + a
+        device-side acceptance update — the whole chain dispatches
+        without touching the host; ONE sync at the end resolves every
+        macro-step's greedy rows and acceptance counts, and the host
+        replays the acceptance chain to append tokens and retire
+        finished requests.  Rollback of rejected draft tails is free:
+        their pool rows sit at positions past the committed length,
+        masked from every live query (``pos_kv <= apos``), and the next
+        macro-step's scatter overwrites them before any read — in both
+        the target and the draft pool."""
+        sync, k = self.sync_every, self.spec_k
+        L0 = self._h_lengths.copy()
+        A0 = self._h_active.copy()
+        toks_d = self._put(self._h_tokens)
+        len_d = self._put(self._h_lengths)
+        stop_d = self._put(self._h_stop)
+        act_d = self._put(self._h_active)
+        pages_d = self._put(self._h_pages)
+        bufs = self.pool.bufs
+        dbufs = self.draft_pool.bufs
+        if self.telem is not None:
+            blk0 = self._put(np.zeros((self.max_batch, k + 1),
+                                      np.int32))
+            self.telem.attach_step_hlo(self._verify, bufs, self._params,
+                                       pages_d, blk0, len_d, stop_d,
+                                       act_d,
+                                       trees={"kv_pool": bufs,
+                                              "params": self._params},
+                                       prediction=self._mem_prediction)
+        t_burst = time.perf_counter()
+        g_steps, e_steps = [], []
+        for _ in range(sync):
+            # k draft self-decode steps propose a token chain per slot;
+            # the draft runs against ITS pool at the same page table,
+            # with the same stop_at so it can never write past a grant
+            d_toks, d_len, d_act = toks_d, len_d, act_d
+            props = [toks_d]
+            for _i in range(k):
+                d_toks, d_len, d_act, dbufs, _docc = self._draft_decode(
+                    dbufs, self._draft_params, pages_d, d_toks, d_len,
+                    stop_d, d_act)
+                props.append(d_toks)
+            blk = jnp.stack(props, axis=1)          # (B, k+1)
+            g_d, bufs, occ = self._verify(bufs, self._params, pages_d,
+                                          blk, len_d, stop_d, act_d)
+            pump.emit(occ)
+            toks_d, len_d, act_d, e_d = self._accept(
+                blk, g_d, toks_d, len_d, stop_d, act_d)
+            g_steps.append(g_d)
+            e_steps.append(e_d)
+        self.pool.bufs = bufs
+        self.draft_pool.bufs = dbufs
+        self.stats["decode_steps"] += sync
+        self.stats["draft_steps"] += sync * k
+        arrs = g_steps + e_steps + [toks_d]
+        if self.watchdog is not None:
+            mats = self.watchdog.block(
+                lambda ts: [np.asarray(t) for t in ts],   # sync-ok
+                arrs, step=self.stats["decode_steps"])
+        else:
+            mats = [np.asarray(t) for t in arrs]          # sync-ok
+        self.stats["host_sync_count"] += 1
+        gs, es = mats[:sync], mats[sync:2 * sync]
+        burst_s = time.perf_counter() - t_burst
+        spans = getattr(self.telem, "spans", None)
+        if spans is not None:
+            spans.record("serve/spec_burst", start_perf=t_burst,
+                         end_perf=time.perf_counter(), cat="serve",
+                         steps=int(sync), k=int(k),
+                         replica=self.replica)
+        t_book = time.perf_counter()
+        active, lengths = A0.copy(), L0.copy()
+        occ_burst, emitted = [], 0
+        proposed = accepted = 0
+        for j in range(sync):
+            occ_burst.append(int(active.sum()))
+            for b in np.nonzero(active)[0]:
+                e_b = int(es[j][b])
+                self.batcher.slot_request(int(b)).tokens.extend(
+                    int(t) for t in gs[j][b, :e_b])
+                emitted += e_b
+                proposed += k
+                accepted += e_b - 1
+            lengths = lengths + es[j]
+            active = active & (lengths < self._h_stop)
+        self.stats["spec_proposed"] += proposed
+        self.stats["spec_accepted"] += accepted
+        from ..telemetry.metrics import maybe_inc
+        maybe_inc(self.batcher.metrics, "spec_proposed_total", proposed)
+        maybe_inc(self.batcher.metrics, "spec_accepted_total", accepted)
+        self._h_tokens = mats[-1].copy()
+        self._h_lengths = lengths
+        self._h_active = active
+        now = time.perf_counter() - t0
+        finished = []
+        for b in range(self.max_batch):
+            req = self.batcher.slot_request(b)
+            if req is not None and req.state == DECODE and not active[b]:
+                self.batcher.retire(req, now)
+                self._h_pages[b] = 0     # slot back to the null page
+                self.completed.append(req)
+                finished.append(req)
+        self.stats["bookkeep_s"] += time.perf_counter() - t_book
+        if self.telem is not None:
+            self.telem.step(
+                loss=None, tokens=emitted,
+                tracker_metrics={"last_step_time_s": burst_s / sync},
+                phase="decode",
+                active=round(float(np.mean(occ_burst)), 3),
+                admitted=self.batcher.admitted_total,
+                completed=self.batcher.completed_total,
+                kv_pages_in_use=self.pool.allocator.pages_in_use,
+                pool_util=round(self.pool.utilization, 4),
+                spec_accept_rate=round(accepted / proposed, 4)
+                if proposed else None,
+                completed_requests=[
+                    {"rid": r.rid,
+                     "trace_id": r.trace_id,
+                     "ttft_ms": round(1e3 * (r.ttft_s or 0.0), 3),
+                     "per_token_ms": round(1e3 * (r.per_token_s or 0.0),
+                                           3),
+                     "tokens": len(r.tokens)} for r in finished])
+
     # ---- round loop ---------------------------------------------------
     def start(self, t0: float | None = None) -> None:
         """Arm the engine clock and the persistent pump without driving
@@ -830,13 +1311,28 @@ class ServingEngine:
                         "leak, not load")
                 self._pre_pages[req.rid] = pre
         self.stats["admit_s"] += time.perf_counter() - t_admit
-        for _ in range(self.prefill_chunks_per_round):
-            req = self.batcher.next_prefill()
-            if req is None:
-                break
-            self._prefill_one_chunk(req, t0)
+        if self.flash_prefill:
+            # batched multi-request prefill: all PREFILL residents
+            # advance together, one fixed-shape step per chunk round
+            for _ in range(self.prefill_chunks_per_round):
+                reqs = sorted(
+                    (r for r in self.batcher.slots
+                     if r is not None and r.state == PREFILL),
+                    key=lambda r: r.t_admit)
+                if not reqs:
+                    break
+                self._prefill_batch_chunk(reqs, t0)
+        else:
+            for _ in range(self.prefill_chunks_per_round):
+                req = self.batcher.next_prefill()
+                if req is None:
+                    break
+                self._prefill_one_chunk(req, t0)
         if self._h_active.any():
-            self._decode_burst(self._pump, t0)
+            if self.spec_k:
+                self._spec_burst(self._pump, t0)
+            else:
+                self._decode_burst(self._pump, t0)
         self.stats["rounds"] += 1
         self.stats["occupancy_sum"] += int(self._h_active.sum())
         self.stats["peak_pool_util"] = max(
@@ -911,7 +1407,17 @@ class ServingEngine:
 
     def _jit_sizes(self) -> dict:
         from ..analysis.recompile import jit_cache_size
-        fns = {"decode": self._decode, "prefill": self._prefill}
+        fns = {"decode": self._decode}
+        for name, f in (("prefill", self._prefill),
+                        ("prefill_batch", self._prefill_batch),
+                        ("draft_decode", self._draft_decode),
+                        ("verify", self._verify),
+                        ("accept", self._accept),
+                        ("draft_prefill", self._draft_prefill),
+                        ("draft_prefill_batch",
+                         self._draft_prefill_batch)):
+            if f is not None:
+                fns[name] = f
         if self.disaggregate:
             fns["extract"] = self._extract
             fns["inject"] = self._inject
@@ -947,10 +1453,16 @@ class ServingEngine:
         ndev = len(jax.devices()) if self.mesh is None \
             else int(self.mesh.devices.size)
         steps = max(self.stats["decode_steps"], 1)
-        return {
+        # tokens emitted by decode steps (first token of each completed
+        # request comes from prefill) — the steps-per-token the
+        # speculative leg is judged on
+        dec_toks = max(toks - len(done), 1)
+        rep = {
             "requests": self.batcher.admitted_total,
             "completed": len(done),
-            "ttft_ms": {"p50": pct(ttft, 50), "p99": pct(ttft, 99)},
+            "ttft_ms": {"p50": pct(ttft, 50), "p99": pct(ttft, 99),
+                        "mean": (round(float(ttft.mean()), 3)
+                                 if ttft.size else None)},
             "per_token_ms": {"p50": pct(ptl, 50), "p99": pct(ptl, 99)},
             "tokens_total": toks,
             "tokens_per_s": round(toks / wall, 2),
@@ -970,11 +1482,29 @@ class ServingEngine:
                     self.stats["occupancy_sum"]
                     / max(self.stats["rounds"], 1), 3),
                 "host_syncs": self.stats["host_sync_count"],
+                "decode_steps_per_token": round(
+                    self.stats["decode_steps"] / dec_toks, 4),
             },
             "disaggregated": self.disaggregate,
             "kv_quant": self.kv_quant,
+            "flash_prefill": self.flash_prefill,
             "recompiles_after_warmup": self.retraces_after_warmup(),
         }
+        if self.prefix_cache is not None:
+            rep["prefix_cache"] = self.prefix_cache.stats()
+        if self.spec_k:
+            prop = self.stats["spec_proposed"]
+            rep["speculative"] = {
+                "k": self.spec_k,
+                "draft_layers": self.draft_cfg.num_hidden_layers,
+                "draft_steps": self.stats["draft_steps"],
+                "proposed": prop,
+                "accepted": self.stats["spec_accepted"],
+                "acceptance_rate": round(
+                    self.stats["spec_accepted"] / prop, 4) if prop
+                else None,
+            }
+        return rep
 
 
 def serve(params, cfg, prompts, *, max_new_tokens: int = 16,

@@ -366,6 +366,13 @@ class Fleet:
                         done = rep.engine.step_round(now)
                         self.admission.observe_burst(
                             time.perf_counter() - t_b)
+                        if rep.engine.prefix_cache is not None:
+                            # cache-hit rate feeds the modeled-TTFT
+                            # prior: hits skip prefill chunks, so the
+                            # admission model discounts the service
+                            # round for later offers
+                            self.admission.note_cache_hit_rate(
+                                rep.engine.prefix_cache.hit_rate)
                         rep.bursts += 1
                         if rep.heartbeat is not None:
                             rep.heartbeat.beat(rep.bursts)

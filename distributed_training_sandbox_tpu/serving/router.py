@@ -78,6 +78,10 @@ class AdmissionController:
         self.burst_s = float(burst_s)
         self.steps_per_burst = max(int(steps_per_burst), 1)
         self.calibrate = bool(calibrate)
+        #: prefix-cache hit-rate prior (fraction of prompt pages served
+        #: from cache), EWMA-fed by the engines via
+        #: :meth:`note_cache_hit_rate`; 0 = no cache = the old model
+        self.cache_hit_rate = 0.0
         #: heap of modeled completion times of admitted requests
         self._backlog: list[float] = []
         self.offered_total = 0
@@ -96,7 +100,12 @@ class AdmissionController:
             heapq.heappop(self._backlog)
         depth = len(self._backlog)
         waiting = max(0, depth - self.total_slots)
-        modeled_ttft = (waiting + 1) * self.burst_s
+        # the service round (the +1) is mostly prefill for a fresh
+        # arrival; a prefix-cache hit skips the cached pages' chunks, so
+        # the hit-rate prior discounts that term (floored — the last
+        # prompt page is always prefilled for the first-token logits)
+        service_round = max(1.0 - self.cache_hit_rate, 0.25)
+        modeled_ttft = (waiting + service_round) * self.burst_s
         if waiting >= self.max_queue:
             self.shed_total += 1
             return "queue_full", modeled_ttft, depth
@@ -115,6 +124,16 @@ class AdmissionController:
         keep a bit-stable shed set."""
         if self.calibrate and burst_s > 0:
             self.burst_s = 0.8 * self.burst_s + 0.2 * float(burst_s)
+
+    def note_cache_hit_rate(self, rate: float) -> None:
+        """Prefix-cache hit-rate feedback from an engine (its
+        ``RadixPrefixCache.hit_rate``) — discounts the modeled-TTFT
+        service round for offers made AFTER this call.  Same EWMA
+        discipline and determinism caveat as :meth:`observe_burst`;
+        gated on ``calibrate`` for the same bit-stable-shed reason."""
+        if self.calibrate and 0.0 <= rate <= 1.0:
+            self.cache_hit_rate = (0.8 * self.cache_hit_rate
+                                   + 0.2 * float(rate))
 
 
 class Router:

@@ -62,6 +62,10 @@ class Request:
     state: str = WAITING
     slot: int | None = None
     pages: list[int] | None = None
+    #: radix-cache nodes this request holds refs on; a prefix of
+    #: ``pages`` (same order) — those pages are TRIE-owned, only
+    #: ``pages[len(cache_nodes):]`` go back to the allocator at retire
+    cache_nodes: list = field(default_factory=list)
     prefill_pos: int = 0
     tokens: list[int] = field(default_factory=list)
     t_submit: float | None = None
@@ -104,6 +108,9 @@ class ContinuousBatcher:
         self.completed_total = 0
         # live MetricsRegistry, late-assigned by the engine; None-safe
         self.metrics = None
+        # RadixPrefixCache, late-assigned by the engine when prefix
+        # caching is on; None = every page comes from the allocator
+        self.prefix_cache = None
 
     # ---- queries ------------------------------------------------------
     def has_work(self) -> bool:
@@ -135,21 +142,48 @@ class ContinuousBatcher:
     def admit(self, now: float) -> list[Request]:
         """FCFS: admit while a slot AND the full page grant are free.
         Head-of-line blocking is deliberate — skipping ahead would
-        starve long requests under load."""
+        starve long requests under load.
+
+        With a prefix cache attached, the grant counts only the
+        NON-CACHED suffix: cached full-prompt pages are aliased (refs
+        taken, never written — see ``kv_pool.RadixPrefixCache``) and
+        prefill starts at the matched page boundary.  Under pool
+        pressure the cache is asked to evict idle pages before the
+        head request is declared blocked."""
         admitted = []
+        cache = self.prefix_cache
         while self.waiting:
             free = [b for b, r in enumerate(self.slots) if r is None]
             if not free:
                 break
             req = self.waiting[0]
-            pages = self.allocator.alloc(self.pages_needed(req))
+            nodes = cache.match(req.prompt) if cache is not None else []
+            need = self.pages_needed(req) - len(nodes)
+            pages = self.allocator.alloc(need)
+            if pages is None and cache is not None:
+                ev = cache.evict(need - self.allocator.free_pages)
+                if ev:
+                    from ..telemetry.metrics import maybe_inc
+                    maybe_inc(self.metrics,
+                              "prefix_cache_evictions_total", ev)
+                pages = self.allocator.alloc(need)
             if pages is None:
                 break
             self.waiting.popleft()
-            req.pages = pages
+            req.pages = [n.page for n in nodes] + pages
+            req.cache_nodes = list(nodes)
+            req.prefill_pos = len(nodes) * self.page_size
+            if cache is not None:
+                cache.acquire(nodes)
+                n_full = (req.n_prompt - 1) // self.page_size
+                cache.note_lookup(len(nodes), n_full)
+                from ..telemetry.metrics import maybe_inc
+                maybe_inc(self.metrics, "prefix_cache_hit_pages_total",
+                          len(nodes))
+                maybe_inc(self.metrics,
+                          "prefix_cache_lookup_pages_total", n_full)
             req.slot = free[0]
             req.state = PREFILL
-            req.prefill_pos = 0
             req.t_admit = now
             self.slots[req.slot] = req
             self.admitted_total += 1
@@ -170,14 +204,25 @@ class ContinuousBatcher:
                 f"this batcher (slot={req.slot}, state={req.state}) — "
                 f"double retire or foreign request")
         self.slots[req.slot] = None
-        self.allocator.free(req.pages)
-        req.pages = None
+        self._release_pages(req)
         req.slot = None
         req.state = DONE
         req.t_done = now
         self.completed_total += 1
         from ..telemetry.metrics import maybe_inc
         maybe_inc(self.metrics, "batcher_completed_total")
+
+    def _release_pages(self, req: Request) -> None:
+        """Cached pages go back to the trie (deref, stay resident for
+        the next prefix twin); only request-OWNED pages return to the
+        allocator."""
+        if req.cache_nodes:
+            self.prefix_cache.release(req.cache_nodes)
+        owned = req.pages[len(req.cache_nodes):]
+        if owned:
+            self.allocator.free(owned)
+        req.pages = None
+        req.cache_nodes = []
 
     def release_all(self) -> list[Request]:
         """Failover teardown: free every resident request's slot and
@@ -191,7 +236,7 @@ class ContinuousBatcher:
             if req is None:
                 continue
             self.slots[b] = None
-            self.allocator.free(req.pages)
+            self._release_pages(req)
             reset_for_replay(req)
             orphans.append(req)
         while self.waiting:
@@ -212,6 +257,7 @@ def reset_for_replay(req: Request) -> None:
     req.state = WAITING
     req.slot = None
     req.pages = None
+    req.cache_nodes = []
     req.prefill_pos = 0
     req.tokens = []
     req.t_admit = None

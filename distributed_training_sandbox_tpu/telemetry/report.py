@@ -340,8 +340,9 @@ def render_serving(rows: list[dict]) -> str:
     if not srows:
         return "_no serving runs_"
     out = ["| run | reqs | done | TTFT p50/p99 ms | tok p50/p99 ms | "
-           "tok/s | tok/s/dev | occ | pool peak | retraces | mode |",
-           "|---|---|---|---|---|---|---|---|---|---|---|"]
+           "tok/s | tok/s/dev | occ | pool peak | cache hit | "
+           "spec acc | retraces | mode |",
+           "|---|---|---|---|---|---|---|---|---|---|---|---|---|"]
     for r in sorted(srows, key=lambda r: r.get("run_id") or ""):
         s = r["serving"]
         ttft = s.get("ttft_ms") or {}
@@ -352,6 +353,14 @@ def render_serving(rows: list[dict]) -> str:
         mode = "disagg" if s.get("disaggregated") else "unified"
         if s.get("kv_quant"):
             mode += "+kvq"
+        if s.get("flash_prefill"):
+            mode += "+flash"
+        pc = s.get("prefix_cache") or {}
+        sp = s.get("speculative") or {}
+        hit = (f"{100 * pc['hit_rate']:.0f}%"
+               if pc.get("hit_rate") is not None else "—")
+        acc = (f"{100 * sp['acceptance_rate']:.0f}% (k={sp.get('k')})"
+               if sp.get("acceptance_rate") is not None else "—")
         out.append(
             f"| {r.get('run_id', '—')} "
             f"| {_fmt(s.get('requests'), 'd')} "
@@ -362,6 +371,8 @@ def render_serving(rows: list[dict]) -> str:
             f"| {_fmt(s.get('tokens_per_s_per_device'), '.2f')} "
             f"| {_fmt(sched.get('mean_occupancy'), '.2f')} "
             f"| {_fmt(pool.get('peak_util'), '.2f')} "
+            f"| {hit} "
+            f"| {acc} "
             f"| {'0 ✓' if rt == 0 else _fmt(rt, 'd') if rt is not None else '—'} "
             f"| {mode} |")
     return "\n".join(out)

@@ -186,20 +186,28 @@ class KnobSpace:
         return cls(**kw)
 
 
+#: the ServingKnobSpace axis names, in canonical order — axes(),
+#: space_hash(), enumerate() and from_axes() all key off this one tuple
+_SERVING_AXES = ("max_batch", "page_size", "prefill_chunk",
+                 "sync_every", "spec_k", "draft_layers")
+
+
 @dataclass(frozen=True)
 class ServingKnobSpace:
     """The serving-pool half of the knob space (objective = p99
     latency): the ``ServingEngine`` pool knobs ``serve_bench.py``
-    exposes as flags."""
+    exposes as flags, plus the speculative-decoding axes (``spec_k`` =
+    draft proposal length, 0 = off; ``draft_layers`` = depth of the
+    truncated-target draft model — the draft-model choice axis)."""
     max_batch: tuple = (2, 4, 8)
     page_size: tuple = (4, 8, 16)
     prefill_chunk: tuple = (8, 16, 32)
     sync_every: tuple = (2, 4, 8)
+    spec_k: tuple = (0, 2, 4)
+    draft_layers: tuple = (1, 2)
 
     def axes(self) -> dict:
-        return {k: list(getattr(self, k))
-                for k in ("max_batch", "page_size", "prefill_chunk",
-                          "sync_every")}
+        return {k: list(getattr(self, k)) for k in _SERVING_AXES}
 
     def space_hash(self) -> str:
         blob = json.dumps(self.axes(), sort_keys=True)
@@ -211,14 +219,22 @@ class ServingKnobSpace:
             for ps in self.page_size:
                 for pc in self.prefill_chunk:
                     for se in self.sync_every:
-                        out.append({"max_batch": mb, "page_size": ps,
+                        for sk in self.spec_k:
+                            # draft_layers only varies a live draft:
+                            # spec_k=0 pins it to the first value so
+                            # vanilla decode isn't enumerated twice
+                            dls = (self.draft_layers if sk
+                                   else self.draft_layers[:1])
+                            for dl in dls:
+                                out.append({
+                                    "max_batch": mb, "page_size": ps,
                                     "prefill_chunk": pc,
-                                    "sync_every": se})
+                                    "sync_every": se, "spec_k": sk,
+                                    "draft_layers": dl})
         return out
 
     @classmethod
     def from_axes(cls, axes: dict) -> "ServingKnobSpace":
         kw = {k: tuple(v) for k, v in axes.items()
-              if k in ("max_batch", "page_size", "prefill_chunk",
-                       "sync_every")}
+              if k in _SERVING_AXES}
         return cls(**kw)

@@ -178,9 +178,16 @@ def _serving_proxy(k: dict) -> float:
     decides among the top-k; this only picks WHICH k to measure): more
     decode slots amortize the per-step scheduler overhead, bigger
     prefill chunks cut TTFT chunking stalls, tighter sync cadence costs
-    host round-trips."""
+    host round-trips.  Speculation is priced as a mild bonus that grows
+    with k but is taxed by draft depth (k draft forwards ride every
+    verify) — measurement owns the real acceptance-rate question."""
+    spec = 0.0
+    if k.get("spec_k"):
+        spec = (0.4 * k["spec_k"]
+                - 0.2 * k["spec_k"] * k.get("draft_layers", 1))
     return (k["max_batch"] * 1.0 + k["prefill_chunk"] / 32.0
-            - 4.0 / max(k["sync_every"], 1) - k["page_size"] / 64.0)
+            - 4.0 / max(k["sync_every"], 1) - k["page_size"] / 64.0
+            + spec)
 
 
 def _measure_serving_knobs(knobs: dict, n_requests: int = 16) -> dict:

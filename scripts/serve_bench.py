@@ -30,8 +30,18 @@ a third hard gate: any DROPPED request — admitted but never completed,
 through kills, hangs, and swaps — exits nonzero (shed requests are
 rejections, not drops).
 
+The decode-speed-frontier legs ride the same trace and gates:
+``--prefix-cache`` (radix prefix reuse; pair with ``--tenants N
+--overlap-frac F`` for the tenant-skewed trace whose requests share
+system prompts), ``--spec-k K --draft-layers N`` (speculative decoding
+via a truncated-target draft), ``--flash-prefill`` (batched prefill
+through the Pallas flash kernel).  All three keep the bitwise parity
+gate — temp-0 speculation and the single-tile flash kernel are exact.
+
     python scripts/serve_bench.py --requests 64 --rate 16 --tp 2
     python scripts/serve_bench.py --requests 8 --disaggregate
+    python scripts/serve_bench.py --tenants 4 --overlap-frac 0.7 --prefix-cache
+    python scripts/serve_bench.py --spec-k 3 --draft-layers 1
     python scripts/serve_bench.py --replicas 2 --inject-fault kill_replica@2:1
     python scripts/serve_bench.py --replicas 2 --rate 200 --deadline-ms 400
 """
@@ -47,20 +57,40 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def build_trace(rng, n_requests: int, rate: float, vocab: int,
-                max_seq_len: int):
+                max_seq_len: int, *, tenants: int = 0,
+                overlap_frac: float = 0.0, sys_len: int = 16):
     """(arrival_s, prompt, max_new) triples: Poisson arrivals, bimodal
     prompt lengths (70 % chat-short 4–16, 30 % document-long 24–48,
-    clipped to capacity), 4–24 new tokens."""
+    clipped to capacity), 4–24 new tokens.
+
+    Tenant-skewed mode (``tenants > 0``): each of ``tenants`` tenants
+    owns a fixed ``sys_len``-token system prompt drawn up front; an
+    ``overlap_frac`` fraction of requests opens with a (uniformly
+    chosen) tenant's system prompt followed by a unique user suffix —
+    the traffic shape the radix prefix cache exists for.  Everything
+    is drawn from the one seeded ``rng``, so cache-hit rates and TTFT
+    deltas reproduce run-to-run from the seed alone."""
+    sys_prompts = [rng.integers(1, vocab, size=sys_len).astype("int32")
+                   for _ in range(tenants)]
     t = 0.0
     trace = []
+    import numpy as np
     for _ in range(n_requests):
         t += float(rng.exponential(1.0 / rate))
-        long = rng.random() < 0.3
-        plen = int(rng.integers(24, 49) if long else rng.integers(4, 17))
         new = int(rng.integers(4, 25))
-        plen = min(plen, max_seq_len - new)
-        prompt = rng.integers(1, vocab, size=plen)
-        trace.append((t, prompt.astype("int32"), new))
+        if sys_prompts and rng.random() < overlap_frac:
+            head = sys_prompts[int(rng.integers(len(sys_prompts)))]
+            tail = rng.integers(1, vocab,
+                                size=int(rng.integers(4, 17)))
+            prompt = np.concatenate(
+                [head, tail.astype("int32")])[:max_seq_len - new]
+        else:
+            long = rng.random() < 0.3
+            plen = int(rng.integers(24, 49) if long
+                       else rng.integers(4, 17))
+            plen = min(plen, max_seq_len - new)
+            prompt = rng.integers(1, vocab, size=plen).astype("int32")
+        trace.append((t, prompt, new))
     return trace
 
 
@@ -86,6 +116,31 @@ def main(argv=None) -> int:
     p.add_argument("--disaggregate", action="store_true",
                    help="prefill/decode on separate device slices with "
                         "page-block KV handoff")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="radix-tree prefix caching over KV pages: "
+                        "requests sharing a prompt prefix alias the "
+                        "same pages; admission grants only the "
+                        "non-cached suffix")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative decoding: the draft proposes K "
+                        "tokens per burst slot, the target verifies "
+                        "them in one (B, K+1) step (0 = off)")
+    p.add_argument("--draft-layers", type=int, default=1,
+                   help="draft model depth for --spec-k: the target's "
+                        "first N layers (truncated-target draft)")
+    p.add_argument("--flash-prefill", action="store_true",
+                   help="batched multi-request prefill through the "
+                        "Pallas flash-attention kernel "
+                        "(ops/flash_prefill.py)")
+    p.add_argument("--tenants", type=int, default=0,
+                   help="tenant-skewed trace: N tenants with fixed "
+                        "shared system prompts (0 = plain bimodal "
+                        "trace)")
+    p.add_argument("--overlap-frac", type=float, default=0.6,
+                   help="fraction of requests opening with a tenant's "
+                        "shared system prompt (needs --tenants)")
+    p.add_argument("--sys-len", type=int, default=16,
+                   help="shared system-prompt length for --tenants")
     p.add_argument("--hbm-budget-gb", type=float, default=None,
                    help="cap the pool via the capacity planner "
                         "(serving.accounting.pool_capacity_pages)")
@@ -162,7 +217,7 @@ def main(argv=None) -> int:
             return 2
         knobs = plan_serving_knobs(doc)
         for k in ("max_batch", "page_size", "prefill_chunk",
-                  "sync_every"):
+                  "sync_every", "spec_k", "draft_layers"):
             if k in knobs:
                 setattr(args, k, int(knobs[k]))
         plan = (doc, args.plan)
@@ -214,14 +269,22 @@ def main(argv=None) -> int:
 
     rng = np.random.default_rng(args.seed)
     trace = build_trace(rng, args.requests, args.rate, cfg.vocab_size,
-                        args.max_seq_len)
+                        args.max_seq_len, tenants=args.tenants,
+                        overlap_frac=args.overlap_frac,
+                        sys_len=args.sys_len)
 
     run_cfg = {"num_steps": 0, "batch_size": args.max_batch,
                "sequence_length": args.max_seq_len, "seed": args.seed,
                "requests": args.requests, "rate": args.rate,
                "page_size": args.page_size, "tp": args.tp,
                "kv_quant": args.kv_quant,
-               "disaggregate": args.disaggregate}
+               "disaggregate": args.disaggregate,
+               "prefix_cache": args.prefix_cache,
+               "spec_k": args.spec_k,
+               "draft_layers": args.draft_layers if args.spec_k else None,
+               "flash_prefill": args.flash_prefill,
+               "tenants": args.tenants,
+               "overlap_frac": args.overlap_frac if args.tenants else None}
     if plan is not None:
         from distributed_training_sandbox_tpu.tuner import (
             plan_manifest_stamp)
@@ -248,7 +311,10 @@ def main(argv=None) -> int:
             prefill_chunk=args.prefill_chunk,
             sync_every=args.sync_every, kv_quant=args.kv_quant,
             hbm_budget_gb=args.hbm_budget_gb,
-            disaggregate=args.disaggregate, telem=telem)
+            disaggregate=args.disaggregate,
+            prefix_cache=args.prefix_cache, spec_k=args.spec_k,
+            draft_layers=args.draft_layers if args.spec_k else None,
+            flash_prefill=args.flash_prefill, telem=telem)
         reqs = [eng.submit(prompt, max_new_tokens=new, arrival_s=t)
                 for t, prompt, new in trace]
         eng.run()
@@ -259,6 +325,18 @@ def main(argv=None) -> int:
               f"{slo['per_token_ms']['p50']} ms, "
               f"{slo['tokens_per_s']} tok/s "
               f"({slo['tokens_per_s_per_device']}/device)", flush=True)
+        if "prefix_cache" in slo:
+            pc = slo["prefix_cache"]
+            print(f"[serve] prefix cache: hit rate {pc['hit_rate']} "
+                  f"({pc['hit_pages']}/{pc['lookup_pages']} pages), "
+                  f"{pc['evictions']} evictions", flush=True)
+        if "speculative" in slo:
+            sp = slo["speculative"]
+            print(f"[serve] speculative k={sp['k']}: acceptance "
+                  f"{sp['acceptance_rate']} "
+                  f"({sp['accepted']}/{sp['proposed']}), "
+                  f"{slo['scheduler']['decode_steps_per_token']} "
+                  f"decode steps/token", flush=True)
 
         retr = slo["recompiles_after_warmup"]
         if retr is None or retr > 0:
@@ -345,7 +423,9 @@ def _fleet_main(args) -> int:
 
     rng = np.random.default_rng(args.seed)
     trace = build_trace(rng, args.requests, args.rate, cfg.vocab_size,
-                        args.max_seq_len)
+                        args.max_seq_len, tenants=args.tenants,
+                        overlap_frac=args.overlap_frac,
+                        sys_len=args.sys_len)
     deadline_s = (None if args.deadline_ms is None
                   else args.deadline_ms / 1e3)
     backoff_s = args.burst_ms / 1e3
@@ -382,7 +462,10 @@ def _fleet_main(args) -> int:
             max_seq_len=args.max_seq_len,
             prefill_chunk=args.prefill_chunk,
             sync_every=args.sync_every, kv_quant=args.kv_quant,
-            hbm_budget_gb=args.hbm_budget_gb)
+            hbm_budget_gb=args.hbm_budget_gb,
+            prefix_cache=args.prefix_cache, spec_k=args.spec_k,
+            draft_layers=args.draft_layers if args.spec_k else None,
+            flash_prefill=args.flash_prefill)
         admitted = []
         offset = 0.0
         for t, prompt, new in trace:

@@ -24,6 +24,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 from jax.sharding import Mesh  # noqa: E402
 
+# NOTE: do NOT enable jax's persistent compilation cache here — on this
+# jaxlib (0.4.37 CPU) executables deserialized from the cache segfault
+# under the checkpoint suite (orbax block_until_ready on a cache-hit
+# executable's output while the prefetch producer thread runs).
+# Re-evaluate after a jaxlib bump; the suite recompiles many identical
+# TINY_LM programs and would win minutes from a working cache.
+
 REPO = Path(__file__).resolve().parent.parent
 
 
