@@ -93,6 +93,19 @@ traces it), tuned so the current ``scripts/`` tree is clean at the
     every-N/finalize-only guard — and mark a deliberate per-iteration
     poll with ``# mem-ok``.
 
+  * ``wall-clock-in-sim`` (error, OPT-IN) — a wall-clock read
+    (``time.time()`` / ``perf_counter()`` / ``monotonic()`` and their
+    ``_ns`` twins) in a module that is supposed to run under the fleet
+    simulator's virtual clock (``sim/`` and the sim-clocked serving
+    schedulers): one stray wall read makes a "deterministic" replay
+    drift with host load, which is exactly the bug class the virtual
+    clock exists to kill.  Real-time drivers inside those trees (the
+    live engine's measured-latency stamps) mark the line — or the line
+    above — with ``# clock-ok``.  This check is NOT in the default
+    set — ``lint_tree(..., opt_in={"wall-clock-in-sim"})`` enables it
+    for the swept trees only, since scripts and the rest of the
+    package legitimately read wall clock.
+
 Findings carry a severity; ``scripts/lint_sharding.py`` fails the run
 only on errors (``--strict`` promotes warnings).
 """
@@ -136,6 +149,13 @@ CKPT_GUARDS = {"wait_until_finished", "closing", "Checkpointer",
 # gather is available — a monolithic all_gather in a *step* function is
 # then flagged (the overlap-engine wiring lint)
 RING_VARIANTS = {"ring_all_gather", "all_gather_matmul"}
+# wall-clock reads forbidden in sim-clocked modules (the opt-in
+# wall-clock-in-sim check); matched as time.<fn>() or a bare <fn>()
+# from-import
+WALL_CLOCK_FNS = {"time", "perf_counter", "monotonic", "time_ns",
+                  "perf_counter_ns", "monotonic_ns"}
+# checks that never fire unless a caller opts a tree in
+OPT_IN_CHECKS = {"wall-clock-in-sim"}
 
 SEV_ERROR = "error"
 SEV_WARN = "warn"
@@ -200,6 +220,7 @@ class _Visitor(ast.NodeVisitor):
         self.pallas_no_interpret: list[tuple[int, str]] = []
         self.mem_stats_in_loop: list[tuple[int, str]] = []
         self.spec_literals: list[tuple[int, str]] = []
+        self.wall_clock_calls: list[tuple[int, str]] = []
 
     # -- context tracking -------------------------------------------------
     def _visit_function(self, node):
@@ -302,6 +323,10 @@ class _Visitor(ast.NodeVisitor):
             if nontrivial and any("step" in n.lower()
                                   for n in self._fn_stack):
                 self.spec_literals.append((node.lineno, chain or leaf))
+        if leaf in WALL_CLOCK_FNS and root in ("time", leaf):
+            # time.time() / time.perf_counter() / a bare from-import —
+            # only reported when the tree opted into wall-clock-in-sim
+            self.wall_clock_calls.append((node.lineno, chain or leaf))
         if (leaf in MEM_STATS_FNS and self._loop_depth
                 and not self._jit_depth
                 and any("step" in n.lower() for n in self._fn_stack)):
@@ -417,7 +442,8 @@ def _annotate_assignments(tree: ast.AST) -> None:
                     node.value._assigned_name = t.id
 
 
-def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
+def lint_source(src: str, path: str = "<string>", *,
+                opt_in: set[str] | None = None) -> list[PitfallFinding]:
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
@@ -515,6 +541,18 @@ def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
             f"cardinality); keep the name a static string and put the "
             f"variation in attrs/labels, or mark a provably-closed name "
             f"set with '# span-ok'"))
+    if "wall-clock-in-sim" in (opt_in or ()):
+        for line, chain in v.wall_clock_calls:
+            if _pragma(line, "clock-ok"):
+                continue
+            findings.append(PitfallFinding(
+                path, line, "wall-clock-in-sim", SEV_ERROR,
+                f"{chain}() in a sim-clocked module — a wall-clock "
+                f"read makes the virtual-clock replay drift with host "
+                f"load; take the time from the injected clock (the "
+                f"`now` the round was scheduled at), or mark a "
+                f"real-time driver's measurement site with "
+                f"'# clock-ok'"))
     if v.collective_calls and not v.uses_shard_wrapper:
         line, chain = v.collective_calls[0]
         findings.append(PitfallFinding(
@@ -525,25 +563,30 @@ def lint_source(src: str, path: str = "<string>") -> list[PitfallFinding]:
     return findings
 
 
-def lint_file(path) -> list[PitfallFinding]:
+def lint_file(path, *, opt_in: set[str] | None = None
+              ) -> list[PitfallFinding]:
     p = Path(path)
-    return lint_source(p.read_text(), str(p))
+    return lint_source(p.read_text(), str(p), opt_in=opt_in)
 
 
 def lint_tree(root, *, recursive: bool = False,
-              checks: set[str] | None = None) -> list[PitfallFinding]:
+              checks: set[str] | None = None,
+              opt_in: set[str] | None = None) -> list[PitfallFinding]:
     """Lint every ``*.py`` under ``root``.  Flat by default (the
     scripts/ layout); ``recursive=True`` walks a package tree.
     ``checks`` restricts the findings to those check names — the
     package tree gets only the swallowed-distributed-error check (its
     internals legitimately trip the driver-shaped heuristics, e.g.
-    collective wrappers outside shard_map)."""
+    collective wrappers outside shard_map).  ``opt_in`` enables the
+    checks in ``OPT_IN_CHECKS`` (off everywhere by default) for this
+    tree — e.g. ``opt_in={"wall-clock-in-sim"}`` on the sim-clocked
+    serving/sim trees."""
     findings = []
     pattern = "**/*.py" if recursive else "*.py"
     for p in sorted(Path(root).glob(pattern)):
         if "__pycache__" in p.parts:
             continue
-        findings.extend(lint_file(p))
+        findings.extend(lint_file(p, opt_in=opt_in))
     if checks is not None:
         findings = [f for f in findings if f.check in checks]
     return findings

@@ -120,21 +120,37 @@ def build_parser() -> argparse.ArgumentParser:
         help="autotune knobs -> plan.json (scripts/tune.py)")
     tune.add_argument("tune_args", nargs=argparse.REMAINDER,
                       help="args for scripts/tune.py (see its --help)")
+
+    # virtual-clock fleet simulator: `dts-launch sim ...` forwards to
+    # scripts/sim_bench.py (traffic sim / --smoke / --validate /
+    # --variant policy ranking / --rank-knobs prerank)
+    sim = sub.add_parser(
+        "sim", add_help=False,
+        help="virtual-clock fleet simulator (scripts/sim_bench.py)")
+    sim.add_argument("sim_args", nargs=argparse.REMAINDER,
+                     help="args for scripts/sim_bench.py (see its "
+                          "--help)")
     return p
+
+
+def _forward(script: str, argv: list) -> int:
+    """Run a scripts/ entry point in-process, argv forwarded verbatim
+    (incl. --help)."""
+    import importlib.util
+    path = Path(__file__).resolve().parents[2] / "scripts" / script
+    spec = importlib.util.spec_from_file_location(
+        f"_dts_{path.stem}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main([a for a in argv if a != "--"])
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv[:1] == ["tune"]:
-        # forward verbatim (incl. --help) to the tuner entry point
-        import importlib.util
-        tune_py = Path(__file__).resolve().parents[2] / "scripts" / \
-            "tune.py"
-        spec = importlib.util.spec_from_file_location("_dts_tune",
-                                                      tune_py)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        return mod.main([a for a in argv[1:] if a != "--"])
+        return _forward("tune.py", argv[1:])
+    if argv[:1] == ["sim"]:
+        return _forward("sim_bench.py", argv[1:])
     args = build_parser().parse_args(argv)
     cfg = _build_config(args)
 

@@ -26,6 +26,8 @@ from .kv_pool import (PageAllocator, PagedKVPool, PoolBuffers,
                       RadixPrefixCache)
 from .router import AdmissionController, Rejection, Router
 from .scheduler import ContinuousBatcher, Request, reset_for_replay
+from .traces import (TraceRequest, build_fleet_trace, build_tenant_trace,
+                     build_trace, trace_digest)
 
 __all__ = [
     "ServingEngine", "serve", "make_serve_decode_step",
@@ -37,4 +39,6 @@ __all__ = [
     "ContinuousBatcher", "Request", "reset_for_replay",
     "kv_bytes_per_step", "weight_read_bytes", "page_bytes",
     "serve_waterline_gb", "pool_capacity_pages",
+    "TraceRequest", "build_trace", "build_tenant_trace",
+    "build_fleet_trace", "trace_digest",
 ]

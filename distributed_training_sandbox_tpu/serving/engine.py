@@ -797,6 +797,10 @@ class ServingEngine:
         self._warm_sizes = None
         self.stats = {"rounds": 0, "decode_steps": 0, "prefill_chunks": 0,
                       "admit_s": 0.0, "bookkeep_s": 0.0,
+                      # measured per-phase device time — the per-burst
+                      # priors the virtual-clock simulator's cost model
+                      # calibrates from (sim.SimCostModel.from_fleet)
+                      "prefill_s": 0.0, "decode_s": 0.0,
                       "occupancy_sum": 0, "peak_pool_util": 0.0,
                       "wall_s": 0.0, "host_sync_count": 0,
                       "draft_steps": 0, "spec_proposed": 0,
@@ -840,8 +844,14 @@ class ServingEngine:
             return False
         if not any(r is None for r in self.batcher.slots):
             return False
-        return (self.pool.allocator.free_pages
-                >= self.batcher.pages_needed(req))
+        # credit the trie's evictable pages: admit() evicts under
+        # pressure, so a grant coverable by free + reclaimable WILL
+        # seat — without the credit a saturated prefix cache wedges
+        # dispatch forever while the replica sits idle
+        free = self.pool.allocator.free_pages
+        if self.prefix_cache is not None:
+            free += self.prefix_cache.reclaimable_pages
+        return free >= self.batcher.pages_needed(req)
 
     def in_flight(self) -> int:
         """Unfinished requests resident in this engine (queued or
@@ -879,7 +889,7 @@ class ServingEngine:
         else:
             row = self._padded_row(req.pages)
             bufs = self.pool.bufs
-        t_chunk = time.perf_counter()
+        t_chunk = time.perf_counter()  # clock-ok
         tok_d, bufs = self._prefill(
             bufs, self._params_pre, self._put(row, dev),
             self._put(ids, dev), self._put(np.int32(pos), dev),
@@ -900,6 +910,7 @@ class ServingEngine:
         req.prefill_pos = min(pos + Ck, req.n_prompt)
         self.stats["prefill_chunks"] += 1
         if req.prefill_pos < req.n_prompt:
+            self.stats["prefill_s"] += time.perf_counter() - t_chunk  # clock-ok
             return
         # final chunk: hand off KV (disaggregated), resolve the first
         # token — prefill is synchronous at admission, so this blocks
@@ -916,13 +927,14 @@ class ServingEngine:
         first = int(np.asarray(tok_d)[0])   # sync-ok: TTFT resolution
         self.stats["host_sync_count"] += 1
         self._finish_prefill(req, first, t_chunk, t0)
+        self.stats["prefill_s"] += time.perf_counter() - t_chunk  # clock-ok
 
     def _finish_prefill(self, req: Request, first: int, t_chunk: float,
                         t0: float) -> None:
         """Shared final-chunk bookkeeping: donate full-prompt pages to
         the prefix cache, stamp TTFT, emit telemetry, and flip the slot
         into DECODE (or retire it when ``max_new == 1``)."""
-        now = time.perf_counter() - t0
+        now = time.perf_counter() - t0  # clock-ok
         if self.prefix_cache is not None:
             # insert at prefill COMPLETION: the request's full prompt
             # pages hold committed KV now, so later arrivals sharing
@@ -938,14 +950,14 @@ class ServingEngine:
                 self._h_pages[req.slot, i] = pg
         req.tokens.append(first)
         req.t_first = now
-        prefill_s = time.perf_counter() - t_chunk
+        prefill_s = time.perf_counter() - t_chunk  # clock-ok
         spans = getattr(self.telem, "spans", None)
         if spans is not None:
             # t_submit/t_admit/t_first ride along (engine-clock seconds)
             # so fleet_timeline can decompose TTFT into queue wait +
             # prefill without re-deriving request state
             spans.record("serve/prefill_chunk", start_perf=t_chunk,
-                         end_perf=time.perf_counter(), cat="serve",
+                         end_perf=time.perf_counter(), cat="serve",  # clock-ok
                          rid=req.rid, n_prompt=int(req.n_prompt),
                          request_id=req.rid, trace_id=req.trace_id,
                          replica=self.replica,
@@ -998,7 +1010,7 @@ class ServingEngine:
         dev = self._prefill_dev
         bufs = self.pool_pre.bufs if self.disaggregate \
             else self.pool.bufs
-        t_chunk = time.perf_counter()
+        t_chunk = time.perf_counter()  # clock-ok
         tok_d, bufs = self._prefill_batch(
             bufs, self._params_pre, self._put(pages, dev),
             self._put(ids, dev), self._put(pos, dev),
@@ -1020,6 +1032,7 @@ class ServingEngine:
             if req.prefill_pos >= req.n_prompt:
                 finishing.append((i, req))
         if not finishing:
+            self.stats["prefill_s"] += time.perf_counter() - t_chunk  # clock-ok
             return
         if self.disaggregate:
             for i, req in finishing:
@@ -1038,6 +1051,7 @@ class ServingEngine:
         self.stats["host_sync_count"] += 1   # sync for all finishers
         for i, req in finishing:
             self._finish_prefill(req, int(toks[i]), t_chunk, t0)
+        self.stats["prefill_s"] += time.perf_counter() - t_chunk  # clock-ok
 
     # ---- decode -------------------------------------------------------
     def _decode_burst(self, pump, t0: float) -> None:
@@ -1060,7 +1074,7 @@ class ServingEngine:
                                        trees={"kv_pool": bufs,
                                               "params": self._params},
                                        prediction=self._mem_prediction)
-        t_burst = time.perf_counter()
+        t_burst = time.perf_counter()  # clock-ok
         step_tokens = []
         for _ in range(sync):
             toks_d, len_d, act_d, bufs, occ = self._decode(
@@ -1082,13 +1096,14 @@ class ServingEngine:
         else:
             mats = [np.asarray(t) for t in step_tokens]   # sync-ok
         self.stats["host_sync_count"] += 1
-        burst_s = time.perf_counter() - t_burst
+        burst_s = time.perf_counter() - t_burst  # clock-ok
+        self.stats["decode_s"] += burst_s
         spans = getattr(self.telem, "spans", None)
         if spans is not None:
             spans.record("serve/decode_burst", start_perf=t_burst,
-                         end_perf=time.perf_counter(), cat="serve",
+                         end_perf=time.perf_counter(), cat="serve",  # clock-ok
                          steps=int(sync), replica=self.replica)
-        t_book = time.perf_counter()
+        t_book = time.perf_counter()  # clock-ok
         active, lengths = A0.copy(), L0.copy()
         occ_burst, emitted = [], 0
         for j in range(sync):
@@ -1102,7 +1117,7 @@ class ServingEngine:
         self._h_tokens = mats[-1].copy()
         self._h_lengths = lengths
         self._h_active = active
-        now = time.perf_counter() - t0
+        now = time.perf_counter() - t0  # clock-ok
         finished = []
         for b in range(self.max_batch):
             req = self.batcher.slot_request(b)
@@ -1111,7 +1126,7 @@ class ServingEngine:
                 self._h_pages[b] = 0     # slot back to the null page
                 self.completed.append(req)
                 finished.append(req)
-        self.stats["bookkeep_s"] += time.perf_counter() - t_book
+        self.stats["bookkeep_s"] += time.perf_counter() - t_book  # clock-ok
         if self.telem is not None:
             self.telem.step(
                 loss=None, tokens=emitted,
@@ -1161,7 +1176,7 @@ class ServingEngine:
                                        trees={"kv_pool": bufs,
                                               "params": self._params},
                                        prediction=self._mem_prediction)
-        t_burst = time.perf_counter()
+        t_burst = time.perf_counter()  # clock-ok
         g_steps, e_steps = [], []
         for _ in range(sync):
             # k draft self-decode steps propose a token chain per slot;
@@ -1195,14 +1210,15 @@ class ServingEngine:
             mats = [np.asarray(t) for t in arrs]          # sync-ok
         self.stats["host_sync_count"] += 1
         gs, es = mats[:sync], mats[sync:2 * sync]
-        burst_s = time.perf_counter() - t_burst
+        burst_s = time.perf_counter() - t_burst  # clock-ok
+        self.stats["decode_s"] += burst_s
         spans = getattr(self.telem, "spans", None)
         if spans is not None:
             spans.record("serve/spec_burst", start_perf=t_burst,
-                         end_perf=time.perf_counter(), cat="serve",
+                         end_perf=time.perf_counter(), cat="serve",  # clock-ok
                          steps=int(sync), k=int(k),
                          replica=self.replica)
-        t_book = time.perf_counter()
+        t_book = time.perf_counter()  # clock-ok
         active, lengths = A0.copy(), L0.copy()
         occ_burst, emitted = [], 0
         proposed = accepted = 0
@@ -1225,7 +1241,7 @@ class ServingEngine:
         self._h_tokens = mats[-1].copy()
         self._h_lengths = lengths
         self._h_active = active
-        now = time.perf_counter() - t0
+        now = time.perf_counter() - t0  # clock-ok
         finished = []
         for b in range(self.max_batch):
             req = self.batcher.slot_request(b)
@@ -1234,7 +1250,7 @@ class ServingEngine:
                 self._h_pages[b] = 0     # slot back to the null page
                 self.completed.append(req)
                 finished.append(req)
-        self.stats["bookkeep_s"] += time.perf_counter() - t_book
+        self.stats["bookkeep_s"] += time.perf_counter() - t_book  # clock-ok
         if self.telem is not None:
             self.telem.step(
                 loss=None, tokens=emitted,
@@ -1262,7 +1278,7 @@ class ServingEngine:
         explicitly with a SHARED ``t0`` so every replica's timestamps
         live on one clock, then drives rounds via :meth:`step_round`."""
         if self._t0 is None:
-            self._t0 = time.perf_counter() if t0 is None else t0
+            self._t0 = time.perf_counter() if t0 is None else t0  # clock-ok
         if self._pump is None:
             from ..runtime.pump import StepPump
             self._pump = StepPump(mode="async",
@@ -1293,7 +1309,7 @@ class ServingEngine:
         self.start()
         t0 = self._t0
         done_base = len(self.completed)
-        t_admit = time.perf_counter()
+        t_admit = time.perf_counter()  # clock-ok
         admitted = self.batcher.admit(now)
         for req in admitted:
             # install the slot's page-table row in the host
@@ -1310,7 +1326,7 @@ class ServingEngine:
                         "like the decode pool, so this is a "
                         "leak, not load")
                 self._pre_pages[req.rid] = pre
-        self.stats["admit_s"] += time.perf_counter() - t_admit
+        self.stats["admit_s"] += time.perf_counter() - t_admit  # clock-ok
         if self.flash_prefill:
             # batched multi-request prefill: all PREFILL residents
             # advance together, one fixed-shape step per chunk round
@@ -1353,7 +1369,7 @@ class ServingEngine:
         newly_done_base = len(self.completed)
         try:
             while pending or self.batcher.has_work():
-                now = time.perf_counter() - t0
+                now = time.perf_counter() - t0  # clock-ok
                 while pending and vt(pending[0]) <= now:
                     self.batcher.submit(pending.pop(0), now)
                 if not self.batcher.has_work():
@@ -1364,7 +1380,7 @@ class ServingEngine:
                 self.step_round(now)
         finally:
             self.close_pump()
-        self.stats["wall_s"] += time.perf_counter() - t0
+        self.stats["wall_s"] += time.perf_counter() - t0  # clock-ok
         return self.completed[newly_done_base:]
 
     # ---- failover / hot-swap -----------------------------------------
@@ -1478,6 +1494,13 @@ class ServingEngine:
                 "admit_ms_total": round(1e3 * self.stats["admit_s"], 3),
                 "bookkeep_ms_total": round(
                     1e3 * self.stats["bookkeep_s"], 3),
+                # measured per-phase totals: divide by prefill_chunks /
+                # decode_steps for the per-burst priors the simulator's
+                # cost model calibrates from
+                "prefill_ms_total": round(
+                    1e3 * self.stats["prefill_s"], 3),
+                "decode_ms_total": round(
+                    1e3 * self.stats["decode_s"], 3),
                 "mean_occupancy": round(
                     self.stats["occupancy_sum"]
                     / max(self.stats["rounds"], 1), 3),

@@ -338,7 +338,7 @@ class Fleet:
         pending = sorted(self._pending, key=vt)
         self._pending = []
         if self._t0 is None:
-            self._t0 = time.perf_counter()
+            self._t0 = time.perf_counter()  # clock-ok
         t0 = self._t0
         for rep in self.replicas:
             if rep.state != "dead":
@@ -347,7 +347,7 @@ class Fleet:
         try:
             while pending or self._has_work() or (
                     self._swap is not None):
-                now = time.perf_counter() - t0
+                now = time.perf_counter() - t0  # clock-ok
                 while pending and vt(pending[0]) <= now:
                     req = pending.pop(0)
                     self.router.enqueue(req)
@@ -362,10 +362,10 @@ class Fleet:
                     try:
                         self.injector.check_serving(
                             rep.idx, rep.bursts, rep.watchdog)
-                        t_b = time.perf_counter()
+                        t_b = time.perf_counter()  # clock-ok
                         done = rep.engine.step_round(now)
                         self.admission.observe_burst(
-                            time.perf_counter() - t_b)
+                            time.perf_counter() - t_b)  # clock-ok
                         if rep.engine.prefix_cache is not None:
                             # cache-hit rate feeds the modeled-TTFT
                             # prior: hits skip prefill chunks, so the
@@ -397,7 +397,7 @@ class Fleet:
             for rep in self.replicas:
                 if rep.state != "dead":
                     rep.engine.close_pump()
-        wall = time.perf_counter() - t0
+        wall = time.perf_counter() - t0  # clock-ok
         for rep in self.replicas:
             rep.engine.stats["wall_s"] = wall
         return self.completed[done_base:]
@@ -439,6 +439,9 @@ class Fleet:
                 "per_token_ms": slo["per_token_ms"],
                 "tokens_per_s": slo["tokens_per_s"],
                 "pool": slo["pool"],
+                # per-phase measured totals ride along so an archived
+                # fleet run can calibrate the simulator's cost model
+                "scheduler": slo["scheduler"],
                 "recompiles_after_warmup":
                     slo["recompiles_after_warmup"],
             })

@@ -155,12 +155,26 @@ class RadixPrefixCache:
         self.lookup_pages = 0
         self.evictions = 0
         self.inserted_pages = 0
+        # refcount-0 node count, maintained O(1) at every transition.
+        # Exact reclaimability: a holder always refs its node's whole
+        # prefix path, so a refs-0 node's subtree is refs-0 throughout
+        # and :meth:`evict` can drain all of it leaf-first.
+        self._idle_pages = 0
 
     # ---- queries ------------------------------------------------------
     @property
     def cached_pages(self) -> int:
         """Pages owned by the trie (not in the allocator free list)."""
         return len(self._nodes)
+
+    @property
+    def reclaimable_pages(self) -> int:
+        """Pages :meth:`evict` could free right now (refcount-0 nodes).
+        ``can_accept`` credits these against a request's page grant —
+        without the credit a saturated trie wedges dispatch forever
+        while every replica sits idle (the fleet-sim-discovered
+        livelock)."""
+        return self._idle_pages
 
     @property
     def hit_rate(self) -> float:
@@ -197,6 +211,8 @@ class RadixPrefixCache:
     def acquire(self, nodes: list[_TrieNode]) -> None:
         self._clock += 1
         for n in nodes:
+            if n.refs == 0:
+                self._idle_pages -= 1
             n.refs += 1
             n.last_used = self._clock
 
@@ -206,6 +222,8 @@ class RadixPrefixCache:
                 raise ValueError("prefix-cache refcount underflow — "
                                  "double release")
             n.refs -= 1
+            if n.refs == 0:
+                self._idle_pages += 1
 
     # ---- growth -------------------------------------------------------
     def insert(self, tokens, pages: list[int],
@@ -232,11 +250,14 @@ class RadixPrefixCache:
                 kids[chunks[i]] = node
                 self._nodes.append(node)
                 self.inserted_pages += 1
+                self._idle_pages += 1   # born refs-0; claimed below
             elif node.page != pages[i]:
                 # two requests with the same prefix prefilled
                 # concurrently; adopt the cached twin, free ours
                 swaps[i] = node.page
                 self.allocator.free([pages[i]])
+            if node.refs == 0:
+                self._idle_pages -= 1
             node.refs += 1
             node.last_used = self._clock
             nodes.append(node)
@@ -261,6 +282,7 @@ class RadixPrefixCache:
             self._nodes.remove(v)
             self.allocator.free([v.page])
             self.evictions += 1
+            self._idle_pages -= 1
             freed += 1
         return freed
 

@@ -161,7 +161,12 @@ class ContinuousBatcher:
             need = self.pages_needed(req) - len(nodes)
             pages = self.allocator.alloc(need)
             if pages is None and cache is not None:
+                # pin the matched prefix first: its refs-0 nodes are
+                # legal LRU victims, and evicting a page this request
+                # is about to alias would hand it a freed page
+                cache.acquire(nodes)
                 ev = cache.evict(need - self.allocator.free_pages)
+                cache.release(nodes)
                 if ev:
                     from ..telemetry.metrics import maybe_inc
                     maybe_inc(self.metrics,

@@ -1,0 +1,30 @@
+"""Virtual-clock fleet simulator: the serving control plane's second,
+fast execution substrate.
+
+The REAL host logic — :class:`~..serving.router.AdmissionController`,
+:class:`~..serving.router.Router`,
+:class:`~..serving.scheduler.ContinuousBatcher`,
+:class:`~..serving.kv_pool.PageAllocator` and
+:class:`~..serving.kv_pool.RadixPrefixCache` — runs UNMODIFIED against
+an injected clock; only the device work (prefill chunks, decode
+bursts, spec verify) is replaced by durations from a
+:class:`~.cost.SimCostModel` calibrated on measured per-burst costs
+from real `serve_bench` runs.  A 10^5-request diurnal tenant-skewed
+trace simulates on the CPU tier in minutes, bitwise-reproducible from
+the seed, and the simulator's TTFT/p99 predictions are validated
+against real fleet runs on matched traces (``tests/test_sim.py``) —
+the same measured-beats-modeled discipline the planner and tuner
+follow.
+
+Entry points: :class:`SimFleet` here, ``scripts/sim_bench.py`` /
+``dts-launch sim`` for trace generation, policy comparison and
+knob-space pre-ranking.
+"""
+
+from .clock import EventHeap, VirtualClock
+from .cost import SimCostModel
+from .engine import SimEngine
+from .fleet import SimFleet, SimReplica, simulate_trace
+
+__all__ = ["VirtualClock", "EventHeap", "SimCostModel", "SimEngine",
+           "SimFleet", "SimReplica", "simulate_trace"]

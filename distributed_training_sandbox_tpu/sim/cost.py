@@ -1,0 +1,219 @@
+"""Calibrated service-time model: what a replica's device work costs
+on the virtual clock.
+
+The fixed-shape law is what makes this model small: every jitted
+serving step has a static shape — a prefill chunk is always ``(1, C)``
+(or ``(B, C)`` batched under flash), a decode burst is always
+``sync_every`` steps over the full ``max_batch`` — so its cost is a
+CONSTANT, independent of occupancy.  The whole device is therefore
+four scalars (admit overhead, per prefill chunk, per decode step, per
+speculative macro-step) plus two control-plane delays (weight-swap
+restore, failover detection).
+
+Calibration follows measured-beats-modeled: :meth:`from_fleet` reads
+the per-phase totals a live :class:`~..serving.fleet.Fleet` just
+accumulated (``stats["prefill_s"] / stats["decode_s"]``),
+:meth:`from_summary` / :meth:`from_run_dir` read the same totals from
+an archived run's ``summary.json`` (``scheduler.prefill_ms_total`` /
+``decode_ms_total``, filed per replica), and the swap/failover delays
+come from the fleet event timeline when one is present.  The
+checked-in defaults are CPU-tier numbers for TINY_LM — good enough
+for policy A/B ranking, NOT for absolute latency claims; anything
+absolute must recalibrate against a real run (the validation gate in
+``tests/test_sim.py`` enforces the agreement band).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, replace
+from pathlib import Path
+
+__all__ = ["SimCostModel"]
+
+
+@dataclass(frozen=True)
+class SimCostModel:
+    """Virtual seconds per unit of replica work (CPU-tier defaults)."""
+    admit_s: float = 2e-4          # scheduler round overhead
+    prefill_chunk_s: float = 8e-3  # one (1, C) prefill step
+    prefill_batch_chunk_s: float = 1.2e-2   # one (B, C) flash chunk
+    decode_step_s: float = 5e-3    # one fixed-shape decode step
+    spec_step_s: float = 9e-3      # one macro-step (k draft + verify)
+    spec_acceptance: float = 0.6   # mean accepted/proposed per slot
+    swap_restore_s: float = 0.15   # checkpoint restore, once per swap
+    failover_detect_s: float = 0.5  # death -> watchdog detection
+    source: str = "defaults"
+
+    # ---- derived -----------------------------------------------------
+    def decode_burst_s(self, sync_every: int, spec_k: int = 0) -> float:
+        """Cost of one burst: ``sync_every`` decode steps, or
+        ``sync_every`` speculative macro-steps when ``spec_k > 0``."""
+        per = self.spec_step_s if spec_k else self.decode_step_s
+        return per * max(int(sync_every), 1)
+
+    def tokens_per_macro_step(self, spec_k: int) -> float:
+        """Expected committed tokens per macro-step: 1 bonus token plus
+        the accepted draft prefix (temp-0 speculation commits
+        1..k+1)."""
+        if not spec_k:
+            return 1.0
+        return 1.0 + float(spec_k) * self.spec_acceptance
+
+    # ---- calibration -------------------------------------------------
+    @classmethod
+    def from_fleet(cls, fleet) -> "SimCostModel":
+        """Calibrate from a live Fleet that just ran: aggregate the
+        replicas' measured per-phase totals into per-unit costs."""
+        stats = [r.engine.stats for r in fleet.replicas]
+        spec_k = getattr(fleet.replicas[0].engine, "spec_k", 0)
+        acc = None
+        prop = sum(s["spec_proposed"] for s in stats)
+        if spec_k and prop:
+            acc = sum(s["spec_accepted"] for s in stats) / prop
+        return cls._from_totals(
+            rounds=sum(s["rounds"] for s in stats),
+            prefill_chunks=sum(s["prefill_chunks"] for s in stats),
+            decode_steps=sum(s["decode_steps"] for s in stats),
+            admit_s=sum(s["admit_s"] for s in stats),
+            prefill_s=sum(s["prefill_s"] for s in stats),
+            decode_s=sum(s["decode_s"] for s in stats),
+            spec_k=spec_k, spec_acceptance=acc,
+            events=getattr(fleet, "events", None),
+            source="fleet:live")
+
+    @classmethod
+    def from_summary(cls, summary: dict,
+                     source: str = "summary") -> "SimCostModel":
+        """Calibrate from an archived run's ``summary.json`` dict —
+        either a fleet run (per-replica scheduler blocks) or a
+        single-engine serving run (one scheduler block)."""
+        scheds, spec_k, acc, events = [], 0, None, None
+        fleet = summary.get("fleet")
+        if fleet:
+            scheds = [r["scheduler"] for r in fleet.get(
+                "replica_slo", []) if "scheduler" in r]
+            events = fleet.get("events")
+        serving = summary.get("serving")
+        if serving and not scheds:
+            scheds = [serving["scheduler"]]
+            spec = serving.get("speculative") or {}
+            spec_k = spec.get("k", 0)
+            acc = spec.get("acceptance_rate")
+        if not scheds:
+            raise ValueError(
+                "summary has no scheduler block with measured "
+                "per-phase totals (needs a fleet/serving run recorded "
+                "at or after the simulator landed)")
+        tot = lambda k: sum(s.get(k) or 0 for s in scheds)
+        return cls._from_totals(
+            rounds=tot("rounds"), prefill_chunks=tot("prefill_chunks"),
+            decode_steps=tot("decode_steps"),
+            admit_s=tot("admit_ms_total") / 1e3,
+            prefill_s=tot("prefill_ms_total") / 1e3,
+            decode_s=tot("decode_ms_total") / 1e3,
+            spec_k=spec_k, spec_acceptance=acc, events=events,
+            source=source)
+
+    @classmethod
+    def from_run_dir(cls, run_dir) -> "SimCostModel":
+        run_dir = Path(run_dir)
+        summary = json.loads((run_dir / "summary.json").read_text())
+        return cls.from_summary(summary, source=f"run:{run_dir.name}")
+
+    @classmethod
+    def from_registry(cls, db_path) -> "SimCostModel":
+        """Calibrate from the newest REAL (non-sim) serving/fleet row
+        in the run registry whose run_dir still has its summary."""
+        import sqlite3
+        conn = sqlite3.connect(str(db_path))
+        try:
+            conn.row_factory = sqlite3.Row
+            try:
+                rows = conn.execute(
+                    "SELECT run_id, run_dir FROM runs "
+                    "WHERE COALESCE(sim, 0) = 0 "
+                    "ORDER BY started_utc DESC"
+                ).fetchall()
+            except sqlite3.OperationalError:
+                # registry predates the sim column
+                rows = conn.execute(
+                    "SELECT run_id, run_dir FROM runs "
+                    "ORDER BY started_utc DESC").fetchall()
+        finally:
+            conn.close()
+        for row in rows:
+            summ = Path(row["run_dir"] or "") / "summary.json"
+            if not summ.is_file():
+                continue
+            try:
+                return cls.from_summary(
+                    json.loads(summ.read_text()),
+                    source=f"registry:{row['run_id']}")
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue
+        raise ValueError(
+            f"no indexed real run under {db_path} carries measured "
+            f"per-phase scheduler totals — run serve_bench and "
+            f"`scripts/runs.py index` first")
+
+    @classmethod
+    def _from_totals(cls, *, rounds, prefill_chunks, decode_steps,
+                     admit_s, prefill_s, decode_s, spec_k=0,
+                     spec_acceptance=None, events=None,
+                     source="measured") -> "SimCostModel":
+        d = cls()           # defaults fill whatever wasn't measured
+        kw = {"source": source}
+        if rounds:
+            kw["admit_s"] = admit_s / rounds
+        if prefill_chunks and prefill_s > 0:
+            per = prefill_s / prefill_chunks
+            kw["prefill_chunk_s"] = per
+            kw["prefill_batch_chunk_s"] = per * (
+                d.prefill_batch_chunk_s / d.prefill_chunk_s)
+        if decode_steps and decode_s > 0:
+            per = decode_s / decode_steps
+            if spec_k:
+                # the calibration run's decode totals ARE macro-steps
+                kw["spec_step_s"] = per
+                kw["decode_step_s"] = per * (
+                    d.decode_step_s / d.spec_step_s)
+            else:
+                kw["decode_step_s"] = per
+                kw["spec_step_s"] = per * (
+                    d.spec_step_s / d.decode_step_s)
+        if spec_acceptance is not None:
+            kw["spec_acceptance"] = float(spec_acceptance)
+        for k, v in cls._delays_from_events(events or []).items():
+            kw[k] = v
+        return replace(d, **kw)
+
+    @staticmethod
+    def _delays_from_events(events) -> dict:
+        """Swap/failover delays from a fleet event timeline (the chaos
+        rows): restore duration = swap_started−swap_complete span over
+        the replicas swapped; detection delay is only observable as
+        the burst gap before replica_dead, so it stays a default
+        unless a chaos summary pins it."""
+        out = {}
+        t_start, n_replicas = None, 0
+        for ev in events:
+            if ev.get("event") == "swap_started":
+                t_start = ev.get("t_s")
+                n_replicas = max(len(ev.get("replicas", [])), 1)
+            elif ev.get("event") == "swap_complete" \
+                    and t_start is not None:
+                span = float(ev["t_s"]) - float(t_start)
+                if span > 0:
+                    out["swap_restore_s"] = span / n_replicas
+                t_start = None
+        return out
+
+    # ---- (de)serialization -------------------------------------------
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SimCostModel":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})

@@ -1,0 +1,203 @@
+"""SimEngine: one replica's scheduler on the virtual clock.
+
+This is a ServingEngine with the DEVICE removed and nothing else: the
+slot/page bookkeeping is the real
+:class:`~..serving.scheduler.ContinuousBatcher` over the real
+:class:`~..serving.kv_pool.PageAllocator`, the prefix-hit model is the
+real :class:`~..serving.kv_pool.RadixPrefixCache` running on token ids
+and integer page ids exactly as it does in production (match → admit
+grants only the non-cached suffix → insert at prefill completion,
+twin-dedup and LRU eviction included), and
+:meth:`SimEngine.step_round` replays ``ServingEngine.step_round``'s
+round structure — admit, up to ``prefill_chunks_per_round`` prefill
+chunks (FCFS-oldest, or all-residents-batched under flash), one decode
+burst — with each device step replaced by its
+:class:`~.cost.SimCostModel` duration.
+
+The fixed-shape law carries over: a burst costs the same whatever the
+occupancy, so the model is per-step constants, and timing mirrors the
+real engine's stamps — ``t_first`` at the prefill-completing chunk,
+``t_done`` at the end of the retiring burst.  The facade the fleet
+router needs (``can_accept`` / ``enqueue`` / ``in_flight``) matches
+``ServingEngine``'s, which is what lets the real ``Router`` and
+``AdmissionController`` drive replicas without knowing which substrate
+they are on.
+"""
+
+from __future__ import annotations
+
+from ..serving.kv_pool import PageAllocator, RadixPrefixCache
+from ..serving.scheduler import (DECODE, PREFILL, ContinuousBatcher,
+                                 Request)
+from .cost import SimCostModel
+
+__all__ = ["SimEngine"]
+
+
+class SimEngine:
+    """Virtual-clock replica: real host bookkeeping, modeled device."""
+
+    def __init__(self, *, cost: SimCostModel | None = None,
+                 max_batch: int = 4, page_size: int = 8,
+                 max_seq_len: int = 64, n_pages: int | None = None,
+                 prefill_chunk: int = 16,
+                 prefill_chunks_per_round: int = 2,
+                 sync_every: int = 4, prefix_cache: bool = False,
+                 spec_k: int = 0, flash_prefill: bool = False):
+        self.cost = cost if cost is not None else SimCostModel()
+        self.max_batch = int(max_batch)
+        self.page_size = int(page_size)
+        self.pages_per_request = -(-int(max_seq_len) // self.page_size)
+        self.view_capacity = self.pages_per_request * self.page_size
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefill_chunks_per_round = int(prefill_chunks_per_round)
+        self.sync_every = max(int(sync_every), 1)
+        self.spec_k = int(spec_k)
+        self.flash_prefill = bool(flash_prefill)
+        if n_pages is None:
+            n_pages = self.max_batch * self.pages_per_request + 1
+        if n_pages < self.pages_per_request + 1:
+            raise ValueError(
+                f"pool of {n_pages} pages cannot hold one request "
+                f"({self.pages_per_request} pages + null)")
+        self.n_pages = int(n_pages)
+        self.allocator = PageAllocator(self.n_pages)
+        self.batcher = ContinuousBatcher(self.max_batch, self.allocator,
+                                         self.page_size)
+        self.prefix_cache = (RadixPrefixCache(self.allocator,
+                                              self.page_size)
+                             if prefix_cache else None)
+        self.batcher.prefix_cache = self.prefix_cache
+        self.completed: list[Request] = []
+        # per-slot decode progress (the engine's host mirrors, sans
+        # device arrays): committed length, stop position, fractional
+        # speculative token credit
+        self._lengths = [0] * self.max_batch
+        self._stop = [0] * self.max_batch
+        self._spec_credit = [0.0] * self.max_batch
+        self.stats = {"rounds": 0, "decode_steps": 0,
+                      "prefill_chunks": 0, "occupancy_sum": 0,
+                      "peak_pool_util": 0.0, "busy_s": 0.0}
+
+    # ---- the router-facing facade (mirrors ServingEngine) ------------
+    def can_accept(self, req: Request) -> bool:
+        if self.batcher.waiting:
+            return False
+        if not any(r is None for r in self.batcher.slots):
+            return False
+        # same evictable-page credit as ServingEngine.can_accept
+        free = self.allocator.free_pages
+        if self.prefix_cache is not None:
+            free += self.prefix_cache.reclaimable_pages
+        return free >= self.batcher.pages_needed(req)
+
+    def in_flight(self) -> int:
+        return len(self.batcher.waiting) + sum(
+            r is not None for r in self.batcher.slots)
+
+    def enqueue(self, req: Request, now: float) -> None:
+        self.batcher.submit(req, now)
+
+    # ---- round execution ---------------------------------------------
+    def _finish_prefill(self, req: Request, t: float) -> None:
+        """Prefill completion at virtual time ``t`` — mirrors the real
+        engine's ``_finish_prefill``: trie insert (twin dedup + page
+        swaps), first-token stamp, flip to DECODE or retire when
+        ``max_new == 1``."""
+        if self.prefix_cache is not None:
+            nodes, swaps = self.prefix_cache.insert(
+                req.prompt, req.pages, req.cache_nodes)
+            req.cache_nodes = nodes
+            for i, pg in swaps.items():
+                req.pages[i] = pg
+        req.tokens.append(0)     # id is irrelevant on this substrate
+        req.t_first = t
+        stop = req.n_prompt + req.max_new_tokens - 1
+        req.state = DECODE
+        if req.n_prompt >= stop:            # max_new == 1
+            self.batcher.retire(req, t)
+            self.completed.append(req)
+            return
+        b = req.slot
+        self._lengths[b] = req.n_prompt
+        self._stop[b] = stop
+        self._spec_credit[b] = 0.0
+
+    def _slot_active(self, b: int) -> bool:
+        req = self.batcher.slot_request(b)
+        return (req is not None and req.state == DECODE
+                and self._lengths[b] < self._stop[b])
+
+    def step_round(self, now: float) -> tuple[list[Request], float]:
+        """One scheduler round starting at virtual time ``now``;
+        returns (requests finished this round, round's virtual cost)."""
+        c = self.cost
+        done_base = len(self.completed)
+        spent = c.admit_s
+        self.batcher.admit(now)
+        # ---- prefill: same chunk schedule as the real engine --------
+        for _ in range(self.prefill_chunks_per_round):
+            if self.flash_prefill:
+                reqs = sorted(
+                    (r for r in self.batcher.slots
+                     if r is not None and r.state == PREFILL),
+                    key=lambda r: r.t_admit)
+                if not reqs:
+                    break
+                spent += c.prefill_batch_chunk_s
+                self.stats["prefill_chunks"] += 1
+                for req in reqs:
+                    req.prefill_pos = min(
+                        req.prefill_pos + self.prefill_chunk,
+                        req.n_prompt)
+                    if req.prefill_pos >= req.n_prompt:
+                        self._finish_prefill(req, now + spent)
+            else:
+                req = self.batcher.next_prefill()
+                if req is None:
+                    break
+                spent += c.prefill_chunk_s
+                self.stats["prefill_chunks"] += 1
+                req.prefill_pos = min(
+                    req.prefill_pos + self.prefill_chunk,
+                    req.n_prompt)
+                if req.prefill_pos >= req.n_prompt:
+                    self._finish_prefill(req, now + spent)
+        # ---- one decode burst (fixed cost, fixed shape) -------------
+        active = [b for b in range(self.max_batch)
+                  if self._slot_active(b)]
+        if active:
+            sync = self.sync_every
+            spent += c.decode_burst_s(sync, self.spec_k)
+            self.stats["decode_steps"] += sync
+            t_end = now + spent
+            per_macro = c.tokens_per_macro_step(self.spec_k)
+            for b in active:
+                req = self.batcher.slot_request(b)
+                remaining = self._stop[b] - self._lengths[b]
+                if self.spec_k:
+                    self._spec_credit[b] += sync * per_macro
+                    grant = min(int(self._spec_credit[b]), remaining)
+                    self._spec_credit[b] -= grant
+                else:
+                    grant = min(sync, remaining)
+                self._lengths[b] += grant
+                req.tokens.extend([0] * grant)
+                if self._lengths[b] >= self._stop[b]:
+                    self.batcher.retire(req, t_end)
+                    self.completed.append(req)
+            self.stats["occupancy_sum"] += len(active)
+        self.stats["rounds"] += 1
+        self.stats["busy_s"] += spent
+        self.stats["peak_pool_util"] = max(
+            self.stats["peak_pool_util"],
+            self.allocator.pages_in_use / max(self.n_pages - 1, 1))
+        return self.completed[done_base:], spent
+
+    # ---- failover -----------------------------------------------------
+    def release_all(self) -> list[Request]:
+        orphans = self.batcher.release_all()
+        self._lengths = [0] * self.max_batch
+        self._stop = [0] * self.max_batch
+        self._spec_credit = [0.0] * self.max_batch
+        return orphans

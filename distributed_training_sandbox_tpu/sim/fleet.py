@@ -1,0 +1,454 @@
+"""SimFleet: the fleet control plane on the virtual clock.
+
+The REAL :class:`~..serving.router.AdmissionController` and
+:class:`~..serving.router.Router` run here unmodified — same bounded
+queue, same modeled-TTFT deadline shedding, same least-loaded dispatch
+over the ``can_accept``/``in_flight`` facade — driving
+:class:`~.engine.SimEngine` replicas whose device work is priced by
+the calibrated :class:`~.cost.SimCostModel`.  The drive loop replays
+``Fleet.run``'s structure event-for-event on virtual time: drain
+arrivals due, roll any armed swap, dispatch, then step each working
+replica's round SERIALLY (the host drives replicas one after another
+in the real loop too — that serialization is part of what the
+calibration measured, so the simulator must reproduce it to land in
+the validation band).
+
+Faults are scheduled on the virtual clock: ``schedule_kill(t, idx)``
+freezes the replica at ``t`` (it keeps its residents and the router
+keeps seeing it ``live`` — a hung replica looks healthy until the
+watchdog fires, and the sim models that blind window) and declares it
+dead ``failover_detect_s`` later, requeueing its unfinished requests
+at the queue head exactly as ``Fleet._on_replica_death`` does.
+Killing several replicas at one instant is the regional-failover
+scenario.  ``schedule_swap_at(t)`` arms the rolling zero-drop weight
+swap with the restore delay charged to the clock.
+
+Everything is deterministic: seeded trace in, bitwise-identical
+completed/shed sets and latency stream out (``digest()`` is the pin).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import numpy as np
+
+from ..serving.router import AdmissionController, Rejection, Router
+from ..serving.scheduler import Request
+from .clock import EventHeap, VirtualClock
+from .cost import SimCostModel
+from .engine import SimEngine
+
+__all__ = ["SimFleet", "SimReplica", "simulate_trace"]
+
+# TTFT thresholds (ms) the attainment curves are sampled at — spans
+# one decode burst up to deep-queue territory on the CPU tier
+ATTAINMENT_GRID_MS = (25.0, 50.0, 100.0, 200.0, 400.0, 800.0,
+                      1600.0, 3200.0, 6400.0, 12800.0)
+
+
+class SimReplica:
+    """Mirror of ``fleet.Replica``: engine + liveness state.  Extra
+    ``frozen`` flag models the hung-but-undetected window between a
+    fault and its watchdog detection."""
+
+    def __init__(self, idx: int, engine: SimEngine):
+        self.idx = int(idx)
+        self.engine = engine
+        self.state = "live"
+        self.frozen = False
+        self.bursts = 0
+        self.death: str | None = None
+
+
+class SimFleet:
+    """N simulated replicas behind the real router + admission."""
+
+    def __init__(self, *, replicas: int = 2,
+                 cost: SimCostModel | None = None,
+                 max_queue: int = 8, burst_s_prior: float = 0.05,
+                 calibrate_admission: bool = True,
+                 deadline_s: float | None = None,
+                 **engine_kwargs):
+        n = int(replicas)
+        if n < 1:
+            raise ValueError(f"need >= 1 replica, got {n}")
+        self.cost = cost if cost is not None else SimCostModel()
+        self.deadline_s = deadline_s
+        self.replicas = [SimReplica(i, SimEngine(cost=self.cost,
+                                                 **engine_kwargs))
+                         for i in range(n)]
+        eng0 = self.replicas[0].engine
+        self.view_capacity = eng0.view_capacity
+        self.admission = AdmissionController(
+            n * eng0.max_batch, max_queue=max_queue,
+            burst_s=burst_s_prior, steps_per_burst=eng0.sync_every,
+            calibrate=calibrate_admission)
+        self.router = Router(self.admission)
+        self._pending: list[Request] = []
+        self._scheduled: list[tuple[float, str, dict]] = []
+        self._rid = 0
+        self.completed: list[Request] = []
+        self.submitted: list[Request] = []
+        self.events: list[dict] = []
+        self.tenant_of: dict[int, int] = {}
+        self._swap: dict | None = None
+        self._pending_cost = 0.0
+        self.clock = VirtualClock(0.0)
+
+    # ---- intake (mirrors Fleet.submit) --------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               arrival_s: float | None = None,
+               deadline_s: float | None = None,
+               tenant: int = -1) -> Request | Rejection:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1 or max_new_tokens < 1:
+            raise ValueError("need >= 1 prompt token and >= 1 new token")
+        if prompt.size + max_new_tokens > self.view_capacity:
+            raise ValueError(
+                f"prompt {prompt.size} + new {max_new_tokens} exceeds "
+                f"the fleet's view capacity {self.view_capacity} "
+                f"(raise max_seq_len)")
+        req = Request(rid=self._rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      arrival_s=(None if arrival_s is None
+                                 else float(arrival_s)))
+        self._rid += 1
+        self.tenant_of[req.rid] = int(tenant)
+        if deadline_s is None:
+            deadline_s = self.deadline_s
+        rej = self.router.submit(req, deadline_s)
+        if rej is not None:
+            return rej
+        self._pending.append(req)
+        self.submitted.append(req)
+        return req
+
+    # ---- fault / swap scheduling --------------------------------------
+    def schedule_kill(self, t_s: float, replica_idx: int) -> None:
+        """Replica dies at ``t_s``; the fleet notices (and fails over)
+        ``cost.failover_detect_s`` later.  Schedule several at the same
+        ``t_s`` for a regional failover."""
+        self._scheduled.append((float(t_s), "freeze",
+                                {"replica": int(replica_idx)}))
+        self._scheduled.append(
+            (float(t_s) + self.cost.failover_detect_s, "kill",
+             {"replica": int(replica_idx)}))
+
+    def schedule_swap_at(self, t_s: float, *,
+                         after_completed: int = 0) -> None:
+        """Arm the rolling weight swap at virtual time ``t_s`` — the
+        restore is charged ``cost.swap_restore_s`` on the clock, then
+        replicas drain and flip one at a time, zero-drop."""
+        self._scheduled.append((float(t_s), "swap",
+                                {"after": int(after_completed)}))
+
+    # ---- event handling -----------------------------------------------
+    def _event(self, now: float, event: str, **kw) -> None:
+        self.events.append({"t_s": round(now, 6), "event": event, **kw})
+
+    def _handle(self, kind: str, payload, now: float) -> None:
+        if kind == "arrival":
+            self.router.enqueue(payload)
+            return
+        if kind == "freeze":
+            rep = self.replicas[payload["replica"]]
+            if rep.state != "dead":
+                rep.frozen = True
+                self._event(now, "replica_fault_injected",
+                            replica=rep.idx)
+            return
+        if kind == "kill":
+            rep = self.replicas[payload["replica"]]
+            if rep.state == "dead":
+                return
+            rep.state = "dead"
+            rep.death = "SimKill"
+            orphans = rep.engine.release_all()
+            self.router.requeue_front(orphans)
+            survivors = [r.idx for r in self.replicas
+                         if r.state == "live"]
+            self._event(now, "replica_dead", replica=rep.idx,
+                        trigger="SimKill", burst=rep.bursts,
+                        requeued=len(orphans))
+            if not survivors:
+                raise RuntimeError(
+                    f"all {len(self.replicas)} replicas dead at "
+                    f"t={now:.3f}s")
+            return
+        if kind == "swap":
+            self._swap = {"after": payload["after"], "state": "armed",
+                          "queue": []}
+            return
+        raise ValueError(f"unknown sim event kind {kind!r}")
+
+    def _maybe_swap(self, now: float, force: bool = False) -> None:
+        sw = self._swap
+        if sw is None:
+            return
+        if sw["state"] == "armed":
+            if len(self.completed) < sw["after"] and not force:
+                return
+            self._pending_cost += self.cost.swap_restore_s
+            sw["queue"] = [r for r in self.replicas
+                           if r.state != "dead"]
+            sw["state"] = "draining"
+            self._event(now, "swap_started",
+                        replicas=[r.idx for r in sw["queue"]])
+        if sw["state"] == "draining":
+            while sw["queue"]:
+                rep = sw["queue"][0]
+                if rep.state == "dead":
+                    sw["queue"].pop(0)
+                    continue
+                rep.state = "draining"
+                if rep.engine.in_flight() > 0:
+                    return
+                rep.state = "live"
+                sw["queue"].pop(0)
+                self._event(now, "swap_replica", replica=rep.idx)
+            self._event(now, "swap_complete")
+            self._swap = None
+
+    # ---- the drive loop (mirrors Fleet.run on virtual time) -----------
+    def _has_work(self) -> bool:
+        return bool(self.router.queue) or any(
+            r.state != "dead" and r.engine.in_flight() > 0
+            for r in self.replicas)
+
+    def run(self) -> list[Request]:
+        heap = EventHeap()
+        arrivals = 0
+        for req in sorted(self._pending,
+                          key=lambda r: (r.arrival_s or 0.0, r.rid)):
+            heap.push(req.arrival_s or 0.0, "arrival", req)
+            arrivals += 1
+        self._pending = []
+        for t, kind, payload in sorted(self._scheduled,
+                                       key=lambda e: e[0]):
+            heap.push(t, kind, payload)
+        self._scheduled = []
+        clock = self.clock
+        done_base = len(self.completed)
+        while True:
+            while heap and heap.peek_t() <= clock.now:
+                _t, kind, payload = heap.pop()
+                if kind == "arrival":
+                    arrivals -= 1
+                self._handle(kind, payload, clock.now)
+            self._maybe_swap(clock.now,
+                             force=arrivals == 0 and not self._has_work())
+            if not self._has_work():
+                if not heap and self._swap is None:
+                    break
+                if heap:
+                    clock.advance_to(heap.peek_t())
+                    continue
+                break    # swap already forced above; nothing else runs
+            self.router.dispatch(self.replicas, clock.now)
+            round_cost, self._pending_cost = self._pending_cost, 0.0
+            progressed = False
+            for rep in self.replicas:
+                if rep.state == "dead" or rep.frozen \
+                        or rep.engine.in_flight() == 0:
+                    continue
+                done, cost = rep.engine.step_round(
+                    clock.now + round_cost)
+                self.admission.observe_burst(cost)
+                if rep.engine.prefix_cache is not None:
+                    self.admission.note_cache_hit_rate(
+                        rep.engine.prefix_cache.hit_rate)
+                rep.bursts += 1
+                round_cost += cost
+                self.completed.extend(done)
+                progressed = True
+            if round_cost > 0:
+                clock.advance(round_cost)
+            if not progressed:
+                # nothing could step (frozen replicas holding work, or
+                # queue waiting on a busy fleet): time passes until the
+                # next scheduled event unfreezes the world
+                if not heap:
+                    if any(r.frozen and r.state != "dead"
+                           for r in self.replicas):
+                        raise RuntimeError(
+                            "sim deadlock: frozen replica holds work "
+                            "but no kill event is scheduled")
+                    if round_cost == 0:
+                        raise RuntimeError(
+                            "sim deadlock: work pending but no replica "
+                            "can progress and no events remain")
+                else:
+                    clock.advance_to(heap.peek_t())
+        return self.completed[done_base:]
+
+    # ---- reporting -----------------------------------------------------
+    def dropped(self) -> list[int]:
+        done = {r.rid for r in self.completed}
+        return [r.rid for r in self.submitted if r.rid not in done]
+
+    def digest(self) -> str:
+        """sha256 over the completed set (rid, t_first, t_done,
+        token count) and the shed set (rid, reason) — THE
+        reproducibility pin: same seed + same knobs ⇒ same digest,
+        bit for bit."""
+        h = hashlib.sha256()
+        for r in sorted(self.completed, key=lambda r: r.rid):
+            h.update(struct.pack(
+                "<qddq", r.rid, float(r.t_first or 0.0),
+                float(r.t_done or 0.0), len(r.tokens)))
+        for rej in self.router.rejections:
+            h.update(struct.pack("<qd", rej.rid, rej.t_s))
+            h.update(rej.reason.encode())
+        return h.hexdigest()
+
+    def slo_report(self, slo_ms: float | None = None) -> dict:
+        """The fleet SLO aggregate on the sim substrate, plus what only
+        this substrate can afford: per-tenant fairness and
+        SLO-attainment curves over the full offered load.  ``slo_ms``
+        is the reference TTFT threshold for the scalar fairness
+        numbers (defaults to the admission deadline, else 400 ms)."""
+        if slo_ms is None:
+            slo_ms = (self.deadline_s * 1e3 if self.deadline_s
+                      else 400.0)
+        done = [r for r in self.completed if r.t_done is not None]
+        ttft = np.array([r.ttft_s for r in done
+                         if r.ttft_s is not None]) * 1e3
+        ptl = np.array([r.per_token_s for r in done
+                        if r.per_token_s is not None]) * 1e3
+        pct = lambda a, q: (round(float(np.percentile(a, q)), 3)
+                            if a.size else None)
+        offered = self.admission.offered_total
+        shed = list(self.router.rejections)
+
+        # ---- per-tenant breakdown + fairness --------------------------
+        ten_done: dict[int, list] = {}
+        ten_offered: dict[int, int] = {}
+        ten_shed: dict[int, int] = {}
+        for rid, ten in self.tenant_of.items():
+            ten_offered[ten] = ten_offered.get(ten, 0) + 1
+        for rej in shed:
+            ten = self.tenant_of.get(rej.rid, -1)
+            ten_shed[ten] = ten_shed.get(ten, 0) + 1
+        for r in done:
+            ten = self.tenant_of.get(r.rid, -1)
+            ten_done.setdefault(ten, []).append(r)
+        grid = list(ATTAINMENT_GRID_MS)
+
+        def curve(reqs, n_offered):
+            tt = np.array([r.ttft_s for r in reqs
+                           if r.ttft_s is not None]) * 1e3
+            n = max(n_offered, 1)
+            return [round(float((tt <= g).sum()) / n, 4) for g in grid]
+
+        tenants = {}
+        attained_fracs = []
+        for ten in sorted(ten_offered):
+            reqs = ten_done.get(ten, [])
+            tt = np.array([r.ttft_s for r in reqs
+                           if r.ttft_s is not None]) * 1e3
+            n_off = ten_offered[ten]
+            att = float((tt <= slo_ms).sum()) / max(n_off, 1)
+            attained_fracs.append(att)
+            tenants[str(ten)] = {
+                "offered": n_off,
+                "completed": len(reqs),
+                "shed": ten_shed.get(ten, 0),
+                "ttft_ms": {"p50": pct(tt, 50), "p99": pct(tt, 99)},
+                "attainment": round(att, 4),
+                "tokens": int(sum(len(r.tokens) for r in reqs)),
+            }
+        fair = np.array(attained_fracs, np.float64)
+        jain = (float(fair.sum()) ** 2
+                / (fair.size * float((fair ** 2).sum()))
+                if fair.size and float((fair ** 2).sum()) > 0 else None)
+        worst = (min(zip(attained_fracs, sorted(ten_offered)))
+                 if attained_fracs else None)
+
+        rep = {
+            "substrate": "sim",
+            "cost_model": self.cost.to_dict(),
+            "replicas": len(self.replicas),
+            "live": sum(r.state == "live" for r in self.replicas),
+            "offered": offered,
+            "submitted": len(self.submitted),
+            "shed": len(shed),
+            "completed": len(done),
+            "dropped": len(self.dropped()),
+            "virtual_duration_s": round(self.clock.now, 6),
+            "ttft_ms": {"p50": pct(ttft, 50), "p99": pct(ttft, 99),
+                        "mean": (round(float(ttft.mean()), 3)
+                                 if ttft.size else None)},
+            "per_token_ms": {"p50": pct(ptl, 50), "p99": pct(ptl, 99)},
+            "admission": {
+                "offered": self.admission.offered_total,
+                "shed": self.admission.shed_total,
+                "max_queue": self.admission.max_queue,
+                "burst_s_prior": round(self.admission.burst_s, 5),
+                "total_slots": self.admission.total_slots,
+            },
+            "rounds": sum(r.bursts for r in self.replicas),
+            "slo_ms": slo_ms,
+            "attainment": {
+                "thresholds_ms": grid,
+                "overall": curve(done, offered),
+            },
+            "tenants": tenants,
+            "fairness": {
+                "jain_attainment": (round(jain, 4)
+                                    if jain is not None else None),
+                "worst_tenant": (
+                    {"tenant": worst[1],
+                     "attainment": round(worst[0], 4)}
+                    if worst else None),
+            },
+            "events": list(self.events),
+            "digest": self.digest(),
+        }
+        if self.replicas[0].engine.prefix_cache is not None:
+            live = [r for r in self.replicas if r.state != "dead"]
+            rep["prefix_cache"] = {
+                "hit_rate": round(float(np.mean(
+                    [r.engine.prefix_cache.hit_rate
+                     for r in live])), 4) if live else None,
+            }
+        return rep
+
+
+def simulate_trace(trace, *, cost: SimCostModel | None = None,
+                   replicas: int = 2, deadline_s: float | None = None,
+                   backoff_s: float | None = None,
+                   kills: tuple = (), swap_at_s: float | None = None,
+                   fleet_kwargs: dict | None = None,
+                   engine_kwargs: dict | None = None) -> SimFleet:
+    """Drive a trace end to end: submit every record in arrival order
+    with serve_bench's queue-full backpressure (later arrivals shift by
+    one modeled burst per overflow — the 429-pacing the real driver
+    applies), schedule any faults, run, return the fleet for
+    reporting.  ``trace`` is a list of
+    :class:`~..serving.traces.TraceRequest` or (t, prompt, new)
+    triples."""
+    fleet = SimFleet(replicas=replicas, deadline_s=deadline_s,
+                     **(fleet_kwargs or {}), cost=cost,
+                     **(engine_kwargs or {}))
+    if backoff_s is None:
+        backoff_s = fleet.admission.burst_s
+    for t_s, idx in kills:
+        fleet.schedule_kill(t_s, idx)
+    if swap_at_s is not None:
+        fleet.schedule_swap_at(swap_at_s)
+    offset = 0.0
+    for rec in trace:
+        if hasattr(rec, "arrival_s"):
+            t, prompt, new, tenant = (rec.arrival_s, rec.prompt,
+                                      rec.max_new, rec.tenant)
+        else:
+            t, prompt, new = rec
+            tenant = -1
+        r = fleet.submit(prompt, max_new_tokens=new,
+                         arrival_s=t + offset, tenant=tenant)
+        if isinstance(r, Rejection) and r.reason == "queue_full":
+            offset += backoff_s
+    fleet.run()
+    return fleet

@@ -160,6 +160,14 @@ def run_row(rec: dict) -> dict:
     # --replicas N): per-replica SLO + the failover/swap event timeline
     if summ.get("fleet") is not None:
         row["fleet"] = summ["fleet"]
+    # simulator block (sim.SimFleet.slo_report, filed by
+    # scripts/sim_bench.py): virtual-clock fleet run — per-tenant
+    # fairness + attainment curves; substrate-tagged so it never joins
+    # a wall-clock comparison silently
+    if summ.get("sim") is not None:
+        row["sim"] = summ["sim"]
+        if summ.get("sim_variants") is not None:
+            row["sim_variants"] = summ["sim_variants"]
     # collective ledger (telemetry.ledger): measured contract verdict +
     # bus bandwidth from the compact manifest/summary block, per-(kind,
     # payload, axis) aggregates from the run dir's collectives.json —
@@ -466,6 +474,73 @@ def render_fleet(rows: list[dict]) -> str:
                      f"dropped {drop}"
                      + (" ⚠" if drop else " ✓")
                      + f"; events: {tl}")
+    return "\n".join(out) + "\n\n" + "\n".join(lines)
+
+
+# ------------------------------------------------------------------- sim
+
+def render_sim(rows: list[dict]) -> str:
+    """Virtual-clock fleet runs (``sim.SimFleet.slo_report`` via
+    ``scripts/sim_bench.py``): the fleet-scale numbers only the
+    simulator can afford — per-tenant SLO attainment and fairness over
+    10^5+ offered requests — plus the policy-variant ranking when the
+    run evaluated one.  All times are VIRTUAL seconds priced by the
+    run's calibrated cost model (``cost_model.source`` says which
+    measured run priced them)."""
+    srows = [r for r in rows if r.get("sim")]
+    if not srows:
+        return "_no simulator runs_"
+    out = ["| run | offered | done | shed | TTFT p50/p99 ms | "
+           "SLO ms | attained | Jain | worst tenant | cost model |",
+           "|---|---|---|---|---|---|---|---|---|---|"]
+    lines = []
+    for r in sorted(srows, key=lambda r: r.get("run_id") or ""):
+        s = r["sim"]
+        ttft = s.get("ttft_ms") or {}
+        fair = s.get("fairness") or {}
+        worst = fair.get("worst_tenant") or {}
+        att = s.get("attainment") or {}
+        # overall attainment at the report's SLO threshold: nearest
+        # grid point at or above slo_ms
+        overall = None
+        th = att.get("thresholds_ms") or []
+        cur = att.get("overall") or []
+        slo = s.get("slo_ms")
+        if th and cur and slo is not None:
+            idx = min((i for i, g in enumerate(th) if g >= slo),
+                      default=len(th) - 1)
+            overall = cur[idx]
+        cm = (s.get("cost_model") or {}).get("source", "—")
+        out.append(
+            f"| {r.get('run_id', '—')} "
+            f"| {_fmt(s.get('offered'), 'd')} "
+            f"| {_fmt(s.get('completed'), 'd')} "
+            f"| {_fmt(s.get('shed'), 'd')} "
+            f"| {_fmt(ttft.get('p50'), '.1f')}/{_fmt(ttft.get('p99'), '.1f')} "
+            f"| {_fmt(slo, '.0f')} "
+            f"| {_fmt(overall, '.1%')} "
+            f"| {_fmt(fair.get('jain_attainment'), '.3f')} "
+            f"| t{worst.get('tenant', '—')} @ "
+            f"{_fmt(worst.get('attainment'), '.1%')} "
+            f"| {cm} |")
+        ev = s.get("events") or []
+        tl = "; ".join(
+            f"{e.get('t_s', '?')}s {e.get('event', '?')}"
+            + (f" r{e['replica']}" if "replica" in e else "")
+            for e in ev) or "none"
+        lines.append(
+            f"- `{r.get('run_id', '—')}`: virtual "
+            f"{_fmt(s.get('virtual_duration_s'), '.1f')}s on "
+            f"{s.get('replicas', '—')} replicas, digest "
+            f"`{(s.get('digest') or '—')[:16]}`; events: {tl}")
+        for v in r.get("sim_variants") or []:
+            vt = v.get("ttft_ms") or {}
+            lines.append(
+                f"  - variant `{v.get('name')}` "
+                f"{v.get('overrides') or {}}: objective "
+                f"{_fmt(v.get('objective'), '.1f')}, TTFT p99 "
+                f"{_fmt(vt.get('p99'), '.1f')} ms, shed "
+                f"{_fmt(v.get('shed'), 'd')}")
     return "\n".join(out) + "\n\n" + "\n".join(lines)
 
 

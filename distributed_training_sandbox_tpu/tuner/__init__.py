@@ -32,6 +32,8 @@ from .plan import (PLAN_SCHEMA, apply_plan_to_train_config, check_plan,
                    plan_serving_knobs, plan_step_kwargs,
                    plan_train_overrides, save_plan)
 from .search import tune
+from .simrank import (PRERANK_SCHEMA, load_prerank, sim_rank_serving,
+                      write_prerank)
 
 __all__ = [
     "KnobSpace", "ServingKnobSpace", "TunerCandidate", "TunerCostModel",
@@ -39,4 +41,6 @@ __all__ = [
     "load_plan", "save_plan", "plan_cfg_overrides", "plan_serving_knobs",
     "plan_step_kwargs", "plan_train_overrides", "plan_manifest_stamp",
     "tune",
+    "PRERANK_SCHEMA", "load_prerank", "sim_rank_serving",
+    "write_prerank",
 ]

@@ -1,0 +1,137 @@
+"""Simulator pre-rank for the serving knob space.
+
+The serving tuner's bottleneck is stage 4: every candidate it measures
+costs a compile + a live trace.  The fleet simulator prices a candidate
+in milliseconds instead — the real admission/router/batcher policy
+stack runs against the calibrated :class:`~..sim.SimCostModel`, so the
+QUEUEING consequences of the knobs (batch slots, page granularity,
+burst length, speculative lookahead) are captured even though the
+device is modeled.  ``sim_rank_serving`` replays one seeded trace
+through every candidate and ranks by the tuner's serving objective
+(p99 TTFT, with sheds priced in), and ``write_prerank`` files the
+ranking as ``sim_prerank.json`` next to the knob-space hash so a later
+``tune --serving`` run can measure only the head of the list.
+
+Candidates that differ only in ``draft_layers`` are sim-twins (the
+cost model prices a macro-step, not the draft depth), so the ranking
+dedups them the same way the space dedups ``spec_k=0``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from ..sim.cost import SimCostModel
+from ..sim.fleet import simulate_trace
+
+__all__ = ["PRERANK_SCHEMA", "load_prerank", "sim_rank_serving",
+           "write_prerank"]
+
+PRERANK_SCHEMA = 1
+
+# the knobs the simulator can actually distinguish — draft_layers only
+# changes which draft net a REAL engine builds
+_SIM_KNOBS = ("max_batch", "page_size", "prefill_chunk", "sync_every",
+              "spec_k")
+
+
+def _objective(rep: dict) -> float:
+    """Smaller is better: p99 TTFT (ms) with a shed penalty — a config
+    that sheds its way to a flat tail must not outrank one that serves
+    the same load."""
+    p99 = rep["ttft_ms"]["p99"]
+    if p99 is None:
+        p99 = float("inf")
+    offered = max(rep["offered"], 1)
+    return float(p99) * (1.0 + rep["shed"] / offered)
+
+
+def sim_rank_serving(space, trace, *, cost: SimCostModel | None = None,
+                     replicas: int = 2, max_seq_len: int = 64,
+                     max_queue: int = 8, deadline_s: float | None = None,
+                     prefix_cache: bool = False,
+                     flash_prefill: bool = False,
+                     top_k: int | None = None) -> list[dict]:
+    """Simulate every candidate in ``space`` (a
+    :class:`~.knobs.ServingKnobSpace`) against ``trace`` and return
+    rows sorted best-first by :func:`_objective`.  Each row carries the
+    knobs, the sim metrics that priced them, and the run digest (the
+    reproducibility pin)."""
+    cost = cost if cost is not None else SimCostModel()
+    seen: dict[tuple, dict] = {}
+    for knobs in space.enumerate():
+        key = tuple(knobs[k] for k in _SIM_KNOBS)
+        if key in seen:
+            seen[key]["sim_twins"].append(dict(knobs))
+            continue
+        if knobs["page_size"] > max_seq_len:
+            continue
+        try:
+            fleet = simulate_trace(
+                trace, cost=cost, replicas=replicas,
+                deadline_s=deadline_s,
+                fleet_kwargs={"max_queue": max_queue},
+                engine_kwargs={
+                    "max_batch": knobs["max_batch"],
+                    "page_size": knobs["page_size"],
+                    "max_seq_len": max_seq_len,
+                    "prefill_chunk": knobs["prefill_chunk"],
+                    "sync_every": knobs["sync_every"],
+                    "spec_k": knobs["spec_k"],
+                    "prefix_cache": prefix_cache,
+                    "flash_prefill": flash_prefill,
+                })
+        except ValueError:
+            # infeasible for this trace (e.g. a prompt outlives the
+            # view capacity) — skip, exactly like the tuner's pre-
+            # compile waterline prune
+            continue
+        rep = fleet.slo_report()
+        seen[key] = {
+            "knobs": dict(knobs),
+            "sim_twins": [],
+            "objective": round(_objective(rep), 3),
+            "ttft_ms": rep["ttft_ms"],
+            "per_token_ms": rep["per_token_ms"],
+            "completed": rep["completed"],
+            "shed": rep["shed"],
+            "virtual_duration_s": rep["virtual_duration_s"],
+            "digest": rep["digest"],
+        }
+    ranked = sorted(seen.values(), key=lambda r: r["objective"])
+    for i, row in enumerate(ranked):
+        row["rank"] = i
+    return ranked[:top_k] if top_k is not None else ranked
+
+
+def write_prerank(path, ranked: list[dict], space,
+                  cost: SimCostModel | None = None) -> dict:
+    """File the ranking as ``sim_prerank.json``: candidates best-first
+    plus the knob-space hash and cost-model provenance, so a consumer
+    can verify it ranks the space it is about to measure."""
+    doc = {
+        "schema": PRERANK_SCHEMA,
+        "space_hash": space.space_hash(),
+        "axes": space.axes(),
+        "cost_model": (cost or SimCostModel()).to_dict(),
+        "candidates": ranked,
+    }
+    p = Path(path)
+    p.write_text(json.dumps(doc, indent=1) + "\n")
+    return doc
+
+
+def load_prerank(path, space=None) -> dict:
+    """Round-trip ``sim_prerank.json``; when ``space`` is given, refuse
+    a ranking whose hash doesn't match the space about to be measured."""
+    doc = json.loads(Path(path).read_text())
+    if int(doc.get("schema") or 0) != PRERANK_SCHEMA:
+        raise ValueError(f"{path}: not a sim_prerank.json (schema "
+                         f"{doc.get('schema')!r})")
+    if space is not None and doc.get("space_hash") != space.space_hash():
+        raise ValueError(
+            f"{path}: ranks space {doc.get('space_hash')} but the "
+            f"space to measure hashes to {space.space_hash()} — "
+            f"re-run sim_bench --rank-knobs")
+    return doc

@@ -403,6 +403,18 @@ def main(argv=None) -> int:
         if serving_dir.is_dir():
             findings += lint_tree(serving_dir, recursive=True,
                                   checks={"host-sync-in-loop"})
+        # clock-seam hygiene: the sim tree and the serving schedulers it
+        # reuses run under the fleet simulator's virtual clock, so any
+        # wall-clock read there (outside the live engine's `# clock-ok`
+        # measurement stamps) silently breaks replay determinism — this
+        # opt-in check stays off for scripts/ and the rest of the
+        # package, which legitimately read wall time
+        for sub in ("sim", "serving"):
+            d = pkg_dir / sub
+            if d.is_dir():
+                findings += lint_tree(
+                    d, recursive=True, checks={"wall-clock-in-sim"},
+                    opt_in={"wall-clock-in-sim"})
         # the launcher tree joins the swallowed-error sweep: a silently
         # eaten exception in process supervision is how a dead worker
         # goes unnoticed until the collective wedges

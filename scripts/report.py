@@ -193,6 +193,10 @@ def main(argv=None) -> int:
             print("\n## Serving fleet (per-replica SLO + event "
                   "timeline)\n")
             print(R.render_fleet(rows))
+        if any(r.get("sim") for r in rows):
+            print("\n## Fleet simulator (virtual-clock, per-tenant "
+                  "fairness)\n")
+            print(R.render_sim(rows))
         if any(r.get("lineage") for r in rows):
             print("\n## Restart lineage (stitched segments)\n")
             print(R.render_lineage(rows))

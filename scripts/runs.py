@@ -77,6 +77,7 @@ CREATE TABLE IF NOT EXISTS runs (
     host_sync_count  REAL,
     contract_ok   INTEGER,
     rules_ok      INTEGER,
+    sim           INTEGER,
     summary_json  TEXT
 );
 CREATE TABLE IF NOT EXISTS lint_verdicts (
@@ -128,7 +129,7 @@ def connect(db_path: str) -> sqlite3.Connection:
     conn.executescript(_SCHEMA_SQL)
     # migrate pre-existing dbs created before the static-verdict columns
     # (CREATE TABLE IF NOT EXISTS never alters an existing table)
-    for col in ("contract_ok", "rules_ok"):
+    for col in ("contract_ok", "rules_ok", "sim"):
         try:
             conn.execute(f"ALTER TABLE runs ADD COLUMN {col} INTEGER")
         except sqlite3.OperationalError:
@@ -177,6 +178,11 @@ def index_run_dir(conn: sqlite3.Connection, run_dir: str) -> str | None:
         # collective-contract verdict and the partition-rules verdict
         "contract_ok": _ok_int(man.get("contract")),
         "rules_ok": _ok_int(man.get("rules")),
+        # simulator runs are marked so queries never silently mix
+        # virtual-clock metrics with wall-clock metrics
+        "sim": 1 if (summary.get("sim")
+                     or (man.get("config") or {}).get("substrate")
+                     == "sim") else 0,
         "summary_json": json.dumps(summary),
     }
     for m in _METRICS:
@@ -285,12 +291,29 @@ def _fetch_run(conn: sqlite3.Connection, run_id: str) -> sqlite3.Row:
     return row
 
 
+def _substrate(row: sqlite3.Row) -> str:
+    try:
+        return "sim" if row["sim"] else "real"
+    except (IndexError, KeyError):
+        return "real"
+
+
 def diff_runs(conn: sqlite3.Connection, run_a: str,
-              run_b: str) -> dict:
+              run_b: str, allow_mixed_substrates: bool = False) -> dict:
     """Regression deltas ``run_b - run_a`` (a = baseline).  Each metric
     row carries the delta, the percentage, and a verdict sign:
-    improved / regressed / flat by the metric's better-direction."""
+    improved / regressed / flat by the metric's better-direction.
+
+    Refuses a sim-vs-real pair unless ``allow_mixed_substrates`` —
+    virtual-clock latencies against wall-clock latencies is not a
+    regression signal, and silently mixing them poisons gates."""
     a, b = _fetch_run(conn, run_a), _fetch_run(conn, run_b)
+    sub_a, sub_b = _substrate(a), _substrate(b)
+    if sub_a != sub_b and not allow_mixed_substrates:
+        raise ValueError(
+            f"substrate mismatch: {run_a} is {sub_a} but {run_b} is "
+            f"{sub_b} — a virtual-clock run cannot gate a wall-clock "
+            f"run (pass --mixed-substrates to annotate instead)")
     metrics = {}
     for m, better in _METRICS.items():
         va, vb = a[m], b[m]
@@ -341,6 +364,8 @@ def diff_runs(conn: sqlite3.Connection, run_a: str,
                             else None,
                             "verdict": verdict}
     return {"baseline": run_a, "current": run_b,
+            "substrates": {"baseline": sub_a, "current": sub_b},
+            "substrate_mismatch": sub_a != sub_b,
             "metrics": metrics, "busbw": busbw, "memory": memory}
 
 
@@ -531,13 +556,14 @@ def _cmd_list(conn, args) -> int:
             params.append(val)
     q += " ORDER BY started_utc, run_id"
     rows = conn.execute(q, params).fetchall()
-    hdr = (f"{'run_id':32} {'strategy':10} {'status':10} "
+    hdr = (f"{'run_id':32} {'strategy':10} {'status':10} {'sim':>3} "
            f"{'steps':>6} {'step_ms':>9} {'tok/s':>12} {'group'}")
     print(hdr)
     print("-" * len(hdr))
     for r in rows:
         print(f"{r['run_id']:32} {str(r['strategy']):10} "
               f"{str(r['status']):10} "
+              f"{'sim' if _substrate(r) == 'sim' else '-':>3} "
               f"{_fmt(r['steps_recorded'], 0):>6} "
               f"{_fmt(r['step_time_ms'], 2):>9} "
               f"{_fmt(r['tokens_per_second'], 0):>12} "
@@ -582,8 +608,19 @@ def _cmd_show(conn, args) -> int:
 
 
 def _cmd_diff(conn, args) -> int:
-    d = diff_runs(conn, args.baseline, args.current)
+    try:
+        d = diff_runs(conn, args.baseline, args.current,
+                      allow_mixed_substrates=args.mixed_substrates)
+    except ValueError as e:
+        print(f"[runs] REFUSED: {e}", file=sys.stderr)
+        return 2
     print(f"[runs] {args.current} vs baseline {args.baseline}")
+    if d["substrate_mismatch"]:
+        print(f"[runs] WARNING: mixed substrates — baseline is "
+              f"{d['substrates']['baseline']}, current is "
+              f"{d['substrates']['current']}; deltas compare a virtual "
+              f"clock against a wall clock and are NOT a regression "
+              f"signal")
     for m, row in d["metrics"].items():
         pct = f" ({row['pct']:+.1f}%)" if row["pct"] is not None else ""
         print(f"  {m:18} {row['baseline']} -> {row['current']} "
@@ -700,6 +737,9 @@ def main(argv=None) -> int:
                    help="also dump the machine-readable diff")
     s.add_argument("--fail-on-regression", action="store_true",
                    help="exit 1 if any metric regressed")
+    s.add_argument("--mixed-substrates", action="store_true",
+                   help="annotate (instead of refuse) a sim-vs-real "
+                        "comparison")
 
     s = sub.add_parser("chaos", help="tabulate indexed chaos campaign "
                                      "cells")

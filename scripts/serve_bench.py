@@ -59,39 +59,18 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 def build_trace(rng, n_requests: int, rate: float, vocab: int,
                 max_seq_len: int, *, tenants: int = 0,
                 overlap_frac: float = 0.0, sys_len: int = 16):
-    """(arrival_s, prompt, max_new) triples: Poisson arrivals, bimodal
-    prompt lengths (70 % chat-short 4–16, 30 % document-long 24–48,
-    clipped to capacity), 4–24 new tokens.
-
-    Tenant-skewed mode (``tenants > 0``): each of ``tenants`` tenants
-    owns a fixed ``sys_len``-token system prompt drawn up front; an
-    ``overlap_frac`` fraction of requests opens with a (uniformly
-    chosen) tenant's system prompt followed by a unique user suffix —
-    the traffic shape the radix prefix cache exists for.  Everything
-    is drawn from the one seeded ``rng``, so cache-hit rates and TTFT
-    deltas reproduce run-to-run from the seed alone."""
-    sys_prompts = [rng.integers(1, vocab, size=sys_len).astype("int32")
-                   for _ in range(tenants)]
-    t = 0.0
-    trace = []
-    import numpy as np
-    for _ in range(n_requests):
-        t += float(rng.exponential(1.0 / rate))
-        new = int(rng.integers(4, 25))
-        if sys_prompts and rng.random() < overlap_frac:
-            head = sys_prompts[int(rng.integers(len(sys_prompts)))]
-            tail = rng.integers(1, vocab,
-                                size=int(rng.integers(4, 17)))
-            prompt = np.concatenate(
-                [head, tail.astype("int32")])[:max_seq_len - new]
-        else:
-            long = rng.random() < 0.3
-            plen = int(rng.integers(24, 49) if long
-                       else rng.integers(4, 17))
-            plen = min(plen, max_seq_len - new)
-            prompt = rng.integers(1, vocab, size=plen).astype("int32")
-        trace.append((t, prompt, new))
-    return trace
+    """(arrival_s, prompt, max_new) triples — moved VERBATIM to
+    ``serving/traces.py`` so the virtual-clock simulator consumes the
+    same seeded draw stream (byte-identical traces per seed, pinned by
+    ``tests/test_sim.py``).  This thin delegate keeps the historical
+    import site alive; the import is deferred so ``--cpu-devices``
+    still configures XLA before any package import can init jax."""
+    from distributed_training_sandbox_tpu.serving.traces import (
+        build_trace as _shared_build_trace)
+    return _shared_build_trace(rng, n_requests, rate, vocab,
+                               max_seq_len, tenants=tenants,
+                               overlap_frac=overlap_frac,
+                               sys_len=sys_len)
 
 
 def main(argv=None) -> int:
@@ -438,7 +417,18 @@ def _fleet_main(args) -> int:
                "inject_fault": args.inject_fault,
                "deadline_ms": args.deadline_ms,
                "swap_at": args.swap_at,
-               "max_queue": args.max_queue}
+               "max_queue": args.max_queue,
+               # everything sim_bench --validate needs to rebuild THIS
+               # run's trace and knobs bit-for-bit
+               "tenants": args.tenants,
+               "overlap_frac": args.overlap_frac,
+               "sys_len": args.sys_len,
+               "prefill_chunk": args.prefill_chunk,
+               "sync_every": args.sync_every,
+               "burst_ms": args.burst_ms,
+               "prefix_cache": args.prefix_cache,
+               "spec_k": args.spec_k,
+               "flash_prefill": args.flash_prefill}
     prof = None
     if args.profile:
         from distributed_training_sandbox_tpu.utils.profiling import (

@@ -1,0 +1,529 @@
+"""Fleet-simulator bench: 10^5+-request traffic through the REAL
+admission/router/batcher policy stack on a virtual clock.
+
+The serving control plane (``AdmissionController``, ``Router``,
+``ContinuousBatcher``, ``RadixPrefixCache``) runs unmodified inside
+``distributed_training_sandbox_tpu.sim``; only the device is replaced,
+by the calibrated :class:`~distributed_training_sandbox_tpu.sim.cost.
+SimCostModel`.  That makes policy questions — shed fairness under
+tenant skew, attainment through a regional failover, which knob config
+survives a flash crowd — answerable in seconds on the CPU tier, with a
+bitwise-reproducible digest per (seed, knobs) pinning every claim.
+
+Modes (composable flags, one trace each):
+
+  * default — one simulated run, SLO/fairness report filed under the
+    run's ``summary.json`` ``sim`` key (``substrate: sim`` in the
+    manifest, so ``runs.py`` never mixes it with wall-clock rows);
+  * ``--diurnal`` — fleet-scale trace (``serving/traces.py:
+    build_fleet_trace``): diurnal sinusoid around ``--base-rate``,
+    Zipf tenant skew, ``--flash-crowd START:DUR:MULT`` windows;
+  * ``--smoke`` — run the seeded config twice, exit nonzero unless
+    the digests match bit for bit (the CI determinism gate);
+  * ``--validate RUN_DIR`` — replay an archived serve_bench fleet
+    run's exact trace through the sim, calibrated from that run's own
+    measured totals; exit nonzero unless the shed set matches EXACTLY
+    and TTFT p50/p99 land within ``--band`` of the real numbers;
+  * ``--variant name:key=val,...`` (repeatable) — evaluate policy /
+    knob variants against the baseline flags on the same trace, ranked
+    by the tuner's serving objective (p99 TTFT with sheds priced in);
+  * ``--rank-knobs`` — pre-rank the full ``ServingKnobSpace`` by
+    simulation and file ``sim_prerank.json`` for ``tune --serving``.
+
+    python scripts/sim_bench.py --requests 100000 --diurnal
+    python scripts/sim_bench.py --smoke --requests 20000 --seed 7
+    python scripts/sim_bench.py --validate runs/<fleet-run>
+    python scripts/sim_bench.py --variant big_batch:max_batch=8 \
+        --variant deep_queue:max_queue=32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# knob keys a --variant may override (everything else is the trace,
+# which variants share by construction)
+_VARIANT_KEYS = ("replicas", "max_batch", "page_size", "max_seq_len",
+                 "prefill_chunk", "sync_every", "spec_k", "max_queue",
+                 "burst_ms", "deadline_ms", "prefix_cache",
+                 "flash_prefill")
+
+
+def _parse_variant(spec: str) -> tuple[str, dict]:
+    """``name:key=val,key=val`` -> (name, overrides)."""
+    name, _, body = spec.partition(":")
+    if not name or not body:
+        raise ValueError(
+            f"--variant {spec!r}: expected name:key=val[,key=val...]")
+    over = {}
+    for item in body.split(","):
+        k, _, v = item.partition("=")
+        k = k.strip()
+        if k not in _VARIANT_KEYS:
+            raise ValueError(
+                f"--variant {name}: unknown knob {k!r} (one of "
+                f"{', '.join(_VARIANT_KEYS)})")
+        vl = v.strip().lower()
+        if vl in ("true", "false"):
+            over[k] = vl == "true"
+        else:
+            try:
+                over[k] = int(v)
+            except ValueError:
+                over[k] = float(v)
+    return name, over
+
+
+def _parse_crowd(spec: str) -> tuple[float, float, float]:
+    """``START:DUR:MULT`` seconds/seconds/multiplier."""
+    parts = spec.split(":")
+    if len(parts) != 3:
+        raise ValueError(f"--flash-crowd {spec!r}: expected "
+                         f"START:DUR:MULT")
+    return float(parts[0]), float(parts[1]), float(parts[2])
+
+
+def _parse_kill(spec: str) -> tuple[float, int]:
+    """``T:IDX`` — replica IDX dies at virtual second T."""
+    t, _, idx = spec.partition(":")
+    return float(t), int(idx)
+
+
+def _load_cost(path: str):
+    """Cost model from a run dir, a summary.json, or a run-registry
+    sqlite file."""
+    from distributed_training_sandbox_tpu.sim import SimCostModel
+    p = Path(path)
+    if p.is_dir():
+        return SimCostModel.from_run_dir(p)
+    if p.suffix == ".json":
+        return SimCostModel.from_summary(
+            json.loads(p.read_text()), source=f"file:{p.name}")
+    return SimCostModel.from_registry(p)
+
+
+def _build_trace(args, vocab: int):
+    import numpy as np
+    from distributed_training_sandbox_tpu.serving.traces import (
+        build_fleet_trace, build_tenant_trace)
+    rng = np.random.default_rng(args.seed)
+    if args.diurnal:
+        return build_fleet_trace(
+            rng, args.requests,
+            base_rate=(args.base_rate if args.base_rate is not None
+                       else args.rate),
+            vocab=vocab, max_seq_len=args.max_seq_len,
+            tenants=args.tenants or 8,
+            overlap_frac=args.overlap_frac, sys_len=args.sys_len,
+            tenant_skew=args.tenant_skew,
+            diurnal_amplitude=args.diurnal_amplitude,
+            diurnal_period_s=args.diurnal_period_s,
+            flash_crowds=tuple(args.flash_crowd or ()))
+    return build_tenant_trace(
+        rng, args.requests, args.rate, vocab, args.max_seq_len,
+        tenants=args.tenants, overlap_frac=args.overlap_frac,
+        sys_len=args.sys_len)
+
+
+def _knobs(args, over: dict | None = None) -> dict:
+    k = {key: getattr(args, key) for key in _VARIANT_KEYS}
+    if over:
+        k.update(over)
+    return k
+
+
+def _simulate(trace, cost, knobs: dict, *, kills=(), swap_at_s=None):
+    from distributed_training_sandbox_tpu.sim import simulate_trace
+    backoff_s = knobs["burst_ms"] / 1e3
+    deadline_s = (None if knobs["deadline_ms"] is None
+                  else knobs["deadline_ms"] / 1e3)
+    return simulate_trace(
+        trace, cost=cost, replicas=knobs["replicas"],
+        deadline_s=deadline_s, backoff_s=backoff_s,
+        kills=kills, swap_at_s=swap_at_s,
+        fleet_kwargs={"max_queue": knobs["max_queue"],
+                      "burst_s_prior": backoff_s},
+        engine_kwargs={"max_batch": knobs["max_batch"],
+                       "page_size": knobs["page_size"],
+                       "max_seq_len": knobs["max_seq_len"],
+                       "prefill_chunk": knobs["prefill_chunk"],
+                       "sync_every": knobs["sync_every"],
+                       "spec_k": knobs["spec_k"],
+                       "prefix_cache": knobs["prefix_cache"],
+                       "flash_prefill": knobs["flash_prefill"]})
+
+
+def _print_report(rep: dict) -> None:
+    t, p = rep["ttft_ms"], rep["per_token_ms"]
+    print(f"[sim] {rep['completed']} completed / {rep['shed']} shed / "
+          f"{rep['dropped']} dropped of {rep['offered']} offered; "
+          f"virtual {rep['virtual_duration_s']:.1f}s across "
+          f"{rep['replicas']} replicas ({rep['live']} live)")
+    print(f"[sim] TTFT p50 {t['p50']} p99 {t['p99']} ms; per-token "
+          f"p50 {p['p50']} p99 {p['p99']} ms; digest {rep['digest'][:16]}")
+    fair = rep.get("fairness") or {}
+    worst = fair.get("worst_tenant")
+    if worst is not None:
+        print(f"[sim] fairness: Jain(attainment) "
+              f"{fair.get('jain_attainment')}, worst tenant "
+              f"{worst['tenant']} at {worst['attainment']:.1%} "
+              f"of SLO {rep['slo_ms']:.0f} ms")
+    for ev in rep.get("events") or []:
+        print(f"[sim]   event {ev['t_s']:.2f}s {ev['event']}"
+              + (f" r{ev['replica']}" if "replica" in ev else ""))
+
+
+def _cmd_validate(args) -> int:
+    """Replay an archived serve_bench --replicas run through the sim
+    and pin the agreement: shed set EXACT, TTFT within --band."""
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.sim import SimCostModel
+
+    run_dir = Path(args.validate)
+    try:
+        man = json.loads((run_dir / "manifest.json").read_text())
+        summary = json.loads((run_dir / "summary.json").read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"[sim] VALIDATE: cannot read run {run_dir}: {e}",
+              file=sys.stderr)
+        return 2
+    fl = summary.get("fleet")
+    cfg_d = man.get("config") or {}
+    if not fl or cfg_d.get("replicas") is None:
+        print(f"[sim] VALIDATE: {run_dir} is not a serve_bench fleet "
+              f"run (no fleet summary block)", file=sys.stderr)
+        return 2
+    if cfg_d.get("inject_fault") or cfg_d.get("swap_at") is not None:
+        print("[sim] VALIDATE: run had faults/swaps injected — their "
+              "wall-clock watchdog timing is not reproducible; "
+              "validate against a fault-free run", file=sys.stderr)
+        return 2
+    needed = ("seed", "requests", "rate", "sequence_length",
+              "batch_size", "prefill_chunk", "sync_every", "burst_ms")
+    missing = [k for k in needed if cfg_d.get(k) is None]
+    if missing:
+        print(f"[sim] VALIDATE: manifest config lacks {missing} — "
+              f"recorded before the simulator landed; re-run "
+              f"serve_bench", file=sys.stderr)
+        return 2
+
+    cost = SimCostModel.from_summary(
+        summary, source=f"run:{run_dir.name}")
+    import numpy as np
+    from distributed_training_sandbox_tpu.serving.traces import (
+        build_tenant_trace)
+    cfg = getattr(T, man.get("model") or "TINY_LM")
+    rng = np.random.default_rng(cfg_d["seed"])
+    trace = build_tenant_trace(
+        rng, cfg_d["requests"], cfg_d["rate"], cfg.vocab_size,
+        cfg_d["sequence_length"], tenants=cfg_d.get("tenants") or 0,
+        overlap_frac=cfg_d.get("overlap_frac") or 0.0,
+        sys_len=cfg_d.get("sys_len") or 16)
+
+    knobs = {"replicas": cfg_d["replicas"],
+             "max_batch": cfg_d["batch_size"],
+             "page_size": cfg_d.get("page_size", 8),
+             "max_seq_len": cfg_d["sequence_length"],
+             "prefill_chunk": cfg_d["prefill_chunk"],
+             "sync_every": cfg_d["sync_every"],
+             "spec_k": cfg_d.get("spec_k") or 0,
+             "max_queue": cfg_d.get("max_queue", 8),
+             "burst_ms": cfg_d["burst_ms"],
+             "deadline_ms": cfg_d.get("deadline_ms"),
+             "prefix_cache": bool(cfg_d.get("prefix_cache")),
+             "flash_prefill": bool(cfg_d.get("flash_prefill"))}
+    fleet = _simulate(trace, cost, knobs)
+    rep = fleet.slo_report()
+
+    failures = []
+    real_shed = {(r["rid"], r["reason"])
+                 for r in fl.get("rejections") or []}
+    sim_shed = {(r.rid, r.reason) for r in fleet.router.rejections}
+    if real_shed != sim_shed:
+        only_real = sorted(real_shed - sim_shed)[:6]
+        only_sim = sorted(sim_shed - real_shed)[:6]
+        failures.append(
+            f"shed sets diverge: real-only {only_real}, "
+            f"sim-only {only_sim} "
+            f"({len(real_shed)} real vs {len(sim_shed)} sim)")
+    if rep["completed"] != fl["completed"]:
+        failures.append(f"completed diverge: real {fl['completed']} "
+                        f"vs sim {rep['completed']}")
+    band = args.band
+    rows = []
+    for q in ("p50", "p99"):
+        rv = (fl.get("ttft_ms") or {}).get(q)
+        sv = rep["ttft_ms"].get(q)
+        ratio = None
+        if rv and sv:
+            ratio = rv / sv
+            if not (1.0 / band <= ratio <= band):
+                failures.append(
+                    f"TTFT {q} outside the {band:.1f}x band: real "
+                    f"{rv:.1f} ms vs sim {sv:.1f} ms (x{ratio:.2f})")
+        elif (rv is None) != (sv is None):
+            failures.append(f"TTFT {q}: real {rv} vs sim {sv}")
+        rows.append((q, rv, sv, ratio))
+
+    print(f"[sim] validate {run_dir.name}: cost model {cost.source}")
+    print(f"[sim]   {'metric':<12} {'real':>10} {'sim':>10} "
+          f"{'real/sim':>9}")
+    print(f"[sim]   {'completed':<12} {fl['completed']:>10} "
+          f"{rep['completed']:>10} {'—':>9}")
+    print(f"[sim]   {'shed':<12} {len(real_shed):>10} "
+          f"{len(sim_shed):>10} "
+          f"{'exact' if real_shed == sim_shed else 'DIVERGED':>9}")
+    for q, rv, sv, ratio in rows:
+        print(f"[sim]   {'ttft ' + q + ' ms':<12} "
+              f"{rv if rv is not None else '—':>10} "
+              f"{sv if sv is not None else '—':>10} "
+              f"{('x%.2f' % ratio) if ratio else '—':>9}")
+    if failures:
+        for f in failures:
+            print(f"[sim] VALIDATE FAILED: {f}", file=sys.stderr)
+        return 1
+    print(f"[sim] VALIDATE OK: shed set exact, TTFT within "
+          f"{band:.1f}x")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="virtual-clock fleet simulator: tenant-skewed "
+                    "traffic through the real serving policy stack")
+    p.add_argument("--model", default="TINY_LM",
+                   help="model config (vocab source for the trace)")
+    p.add_argument("--requests", type=int, default=10000)
+    p.add_argument("--rate", type=float, default=16.0,
+                   help="mean arrival rate, requests/s (bench-matched "
+                        "trace)")
+    p.add_argument("--seed", type=int, default=0)
+    # ---- knobs (serve_bench names, serve_bench defaults) ------------
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--page-size", type=int, default=8)
+    p.add_argument("--max-seq-len", type=int, default=80)
+    p.add_argument("--prefill-chunk", type=int, default=16)
+    p.add_argument("--sync-every", type=int, default=4)
+    p.add_argument("--spec-k", type=int, default=0)
+    p.add_argument("--prefix-cache", action="store_true")
+    p.add_argument("--flash-prefill", action="store_true")
+    p.add_argument("--max-queue", type=int, default=8)
+    p.add_argument("--burst-ms", type=float, default=50.0)
+    p.add_argument("--deadline-ms", type=float, default=None)
+    p.add_argument("--slo-ms", type=float, default=None,
+                   help="TTFT threshold for the scalar fairness "
+                        "numbers (default: deadline, else 400)")
+    # ---- trace shape -------------------------------------------------
+    p.add_argument("--tenants", type=int, default=0)
+    p.add_argument("--overlap-frac", type=float, default=0.6)
+    p.add_argument("--sys-len", type=int, default=16)
+    p.add_argument("--diurnal", action="store_true",
+                   help="fleet-scale trace: diurnal rate sinusoid + "
+                        "Zipf tenant skew (build_fleet_trace)")
+    p.add_argument("--base-rate", type=float, default=None,
+                   help="diurnal mean rate (default: --rate)")
+    p.add_argument("--tenant-skew", type=float, default=1.1)
+    p.add_argument("--diurnal-amplitude", type=float, default=0.6)
+    p.add_argument("--diurnal-period-s", type=float, default=None)
+    p.add_argument("--flash-crowd", action="append", type=_parse_crowd,
+                   metavar="START:DUR:MULT",
+                   help="rate-multiplier window (repeatable)")
+    # ---- chaos -------------------------------------------------------
+    p.add_argument("--kill-at", action="append", type=_parse_kill,
+                   metavar="T:IDX", default=[],
+                   help="replica IDX dies at virtual second T "
+                        "(repeatable; same T = regional failover)")
+    p.add_argument("--swap-at-s", type=float, default=None,
+                   help="arm the rolling weight swap at virtual "
+                        "second T")
+    # ---- modes -------------------------------------------------------
+    p.add_argument("--calibrate-from", metavar="PATH",
+                   help="cost model source: run dir, summary.json, or "
+                        "run-registry sqlite (default: CPU-tier "
+                        "defaults)")
+    p.add_argument("--smoke", action="store_true",
+                   help="determinism gate: run twice, exit 1 unless "
+                        "digests match")
+    p.add_argument("--validate", metavar="RUN_DIR",
+                   help="replay an archived serve_bench fleet run; "
+                        "exit 1 unless shed set is exact and TTFT is "
+                        "within --band")
+    p.add_argument("--band", type=float, default=3.0,
+                   help="multiplicative TTFT agreement band for "
+                        "--validate (default 3.0)")
+    p.add_argument("--variant", action="append", type=_parse_variant,
+                   metavar="NAME:K=V[,K=V...]", default=[],
+                   help="policy variant vs the baseline flags "
+                        "(repeatable); knobs: " + ", ".join(
+                            _VARIANT_KEYS))
+    p.add_argument("--rank-knobs", action="store_true",
+                   help="pre-rank the ServingKnobSpace by simulation "
+                        "and file sim_prerank.json")
+    p.add_argument("--prerank-out", default="sim_prerank.json")
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--no-run", action="store_true",
+                   help="skip the telemetry run dir (report to stdout "
+                        "only)")
+    args = p.parse_args(argv)
+
+    if args.validate:
+        return _cmd_validate(args)
+
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.sim import SimCostModel
+
+    cost = (SimCostModel() if args.calibrate_from is None
+            else _load_cost(args.calibrate_from))
+    cfg = getattr(T, args.model)
+    t0 = time.perf_counter()
+    trace = _build_trace(args, cfg.vocab_size)
+    t_trace = time.perf_counter() - t0
+    print(f"[sim] trace: {len(trace)} requests "
+          f"({'diurnal' if args.diurnal else 'bench-matched'}, seed "
+          f"{args.seed}) built in {t_trace:.2f}s; cost model "
+          f"{cost.source}", flush=True)
+
+    if args.rank_knobs:
+        from distributed_training_sandbox_tpu.tuner import (
+            ServingKnobSpace, sim_rank_serving, write_prerank)
+        space = ServingKnobSpace()
+        t0 = time.perf_counter()
+        ranked = sim_rank_serving(
+            space, trace, cost=cost, replicas=args.replicas,
+            max_seq_len=args.max_seq_len, max_queue=args.max_queue,
+            deadline_s=(None if args.deadline_ms is None
+                        else args.deadline_ms / 1e3),
+            prefix_cache=args.prefix_cache,
+            flash_prefill=args.flash_prefill, top_k=args.top_k)
+        wall = time.perf_counter() - t0
+        write_prerank(args.prerank_out, ranked, space, cost=cost)
+        print(f"[sim] ranked {len(ranked)} sim-distinct candidates in "
+              f"{wall:.1f}s -> {args.prerank_out} (space "
+              f"{space.space_hash()})")
+        for row in ranked[:8]:
+            k = row["knobs"]
+            print(f"[sim]   #{row['rank']:<2} obj {row['objective']:>9} "
+                  f"ttft_p99 {row['ttft_ms']['p99']} ms shed "
+                  f"{row['shed']:<4} mb={k['max_batch']} "
+                  f"ps={k['page_size']} pc={k['prefill_chunk']} "
+                  f"se={k['sync_every']} k={k['spec_k']}")
+        return 0
+
+    if args.smoke:
+        digests = []
+        for i in range(2):
+            t0 = time.perf_counter()
+            fleet = _simulate(trace, cost, _knobs(args),
+                              kills=tuple(args.kill_at),
+                              swap_at_s=args.swap_at_s)
+            wall = time.perf_counter() - t0
+            digests.append(fleet.digest())
+            print(f"[sim] smoke pass {i + 1}: digest {digests[-1]} "
+                  f"({wall:.2f}s wall)")
+        if digests[0] != digests[1]:
+            print("[sim] SMOKE FAILED: same seed, different digests — "
+                  "the sim is reading nondeterministic state",
+                  file=sys.stderr)
+            return 1
+        print(f"[sim] SMOKE OK: deterministic digest {digests[0]}")
+        return 0
+
+    # ---- baseline (+ variants) on the one shared trace ---------------
+    from distributed_training_sandbox_tpu.tuner.simrank import (
+        _objective)
+    rows = []
+    for name, over in [("baseline", {})] + list(args.variant):
+        t0 = time.perf_counter()
+        fleet = _simulate(trace, cost, _knobs(args, over),
+                          kills=tuple(args.kill_at),
+                          swap_at_s=args.swap_at_s)
+        wall = time.perf_counter() - t0
+        rep = fleet.slo_report(slo_ms=args.slo_ms)
+        rows.append({"name": name, "overrides": over, "report": rep,
+                     "objective": round(_objective(rep), 3),
+                     "wall_s": round(wall, 3)})
+        if name == "baseline":
+            base_rep, base_wall = rep, wall
+
+    print(f"[sim] simulated {base_rep['offered']} offered requests "
+          f"(virtual {base_rep['virtual_duration_s']:.1f}s) in "
+          f"{base_wall:.2f}s wall")
+    _print_report(base_rep)
+
+    if len(rows) > 1:
+        ranked = sorted(rows, key=lambda r: r["objective"])
+        print(f"[sim] policy ranking (objective = p99 TTFT x shed "
+              f"penalty; same trace, seed {args.seed}):")
+        print(f"[sim]   {'#':<3} {'variant':<16} {'objective':>10} "
+              f"{'ttft p99':>9} {'shed':>6} {'done':>7} "
+              f"{'worst-tenant':>12}")
+        for i, r in enumerate(ranked):
+            rep = r["report"]
+            worst = (rep["fairness"].get("worst_tenant") or
+                     {}).get("attainment")
+            print(f"[sim]   {i:<3} {r['name']:<16} "
+                  f"{r['objective']:>10} "
+                  f"{rep['ttft_ms']['p99'] or '—':>9} "
+                  f"{rep['shed']:>6} {rep['completed']:>7} "
+                  f"{('%.1f%%' % (100 * worst)) if worst is not None else '—':>12}")
+
+    if args.no_run:
+        return 0
+
+    # ---- file the baseline under a registry-visible sim run ----------
+    from distributed_training_sandbox_tpu.serving.traces import (
+        trace_digest)
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
+    run_cfg = {"substrate": "sim", "num_steps": 0,
+               "batch_size": args.max_batch,
+               "sequence_length": args.max_seq_len,
+               "seed": args.seed, "requests": args.requests,
+               "rate": args.rate, "base_rate": args.base_rate,
+               "diurnal": args.diurnal,
+               "tenant_skew": args.tenant_skew,
+               "diurnal_amplitude": args.diurnal_amplitude,
+               "flash_crowds": [list(c) for c in
+                                (args.flash_crowd or [])],
+               "page_size": args.page_size,
+               "replicas": args.replicas,
+               "prefill_chunk": args.prefill_chunk,
+               "sync_every": args.sync_every,
+               "max_queue": args.max_queue,
+               "burst_ms": args.burst_ms,
+               "deadline_ms": args.deadline_ms,
+               "tenants": args.tenants,
+               "overlap_frac": args.overlap_frac,
+               "sys_len": args.sys_len,
+               "prefix_cache": args.prefix_cache,
+               "spec_k": args.spec_k,
+               "flash_prefill": args.flash_prefill,
+               "kills": [list(k) for k in args.kill_at],
+               "swap_at_s": args.swap_at_s,
+               "trace_digest": trace_digest(trace)}
+    with TelemetryRun("sim", model=args.model,
+                      config=run_cfg) as telem:
+        extra = {"sim": base_rep}
+        if len(rows) > 1:
+            extra["sim_variants"] = [
+                {"name": r["name"], "overrides": r["overrides"],
+                 "objective": r["objective"],
+                 "ttft_ms": r["report"]["ttft_ms"],
+                 "shed": r["report"]["shed"],
+                 "completed": r["report"]["completed"],
+                 "digest": r["report"]["digest"]}
+                for r in sorted(rows, key=lambda r: r["objective"])]
+        telem.finalize(**extra)
+    if telem.run_dir:
+        print(f"[sim] run dir: {telem.run_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -423,3 +423,48 @@ def test_shipped_parallel_tree_spec_clean():
     findings = [f for f in lint_tree(pkg, recursive=True,
                                      checks={"hand-rolled-partition-spec"})]
     assert findings == [], [f"{f.path}:{f.line}" for f in findings]
+
+
+# ---- wall-clock-in-sim (opt-in clock seam for sim-clocked modules) ------
+
+CLOCK_SRC = """
+import time
+def step_round(self, now):
+    t0 = time.perf_counter()
+    return now + (time.perf_counter() - t0)
+"""
+
+
+def test_wall_clock_fires_when_opted_in():
+    fs = [f for f in lint_source(CLOCK_SRC, path="sim/engine.py",
+                                 opt_in={"wall-clock-in-sim"})
+          if f.check == "wall-clock-in-sim"]
+    assert len(fs) == 2 and all(f.severity == SEV_ERROR for f in fs)
+
+
+def test_wall_clock_silent_by_default():
+    """The check is OPT-IN: a default sweep (checks=None, like the
+    scripts/ gate) must never fire it — wall clocks are fine anywhere
+    except modules that promised virtual time."""
+    assert "wall-clock-in-sim" not in _checks(lint_source(CLOCK_SRC))
+
+
+def test_wall_clock_suppressed_by_pragma():
+    src = CLOCK_SRC.replace("time.perf_counter()",
+                            "time.perf_counter()  # clock-ok")
+    assert "wall-clock-in-sim" not in _checks(
+        lint_source(src, opt_in={"wall-clock-in-sim"}))
+
+
+def test_shipped_sim_and_serving_trees_clock_clean():
+    """The seam the simulator depends on: serving/ (shared policy
+    classes) and sim/ never read a wall clock except at `# clock-ok`
+    engine-boundary stamps — the sweep lint_sharding.py runs in CI."""
+    pkg = Path(__file__).resolve().parent.parent \
+        / "distributed_training_sandbox_tpu"
+    findings = []
+    for sub in ("sim", "serving"):
+        findings += lint_tree(pkg / sub, recursive=True,
+                              checks={"wall-clock-in-sim"},
+                              opt_in={"wall-clock-in-sim"})
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]

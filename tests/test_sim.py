@@ -1,0 +1,469 @@
+"""Virtual-clock fleet simulator suite — THE acceptance for the sim
+substrate: the shared trace generator draws byte-identical streams for
+serve_bench and the simulator from one seed; a simulated fleet run is
+digest-deterministic (shed set included, at 10^4+ requests); the
+admission prior's edge cases (service-round floor at full cache-hit
+rate, EWMA convergence, frozen prior) hold; and — the validation gate —
+replaying a small trace through BOTH the real ``serving.Fleet`` and the
+sim calibrated from that very run produces the EXACT same shed set and
+TTFT percentiles within a calibrated band.  Plus the satellites the sim
+forced into the real code: the prefix-cache reclaimable-page counter is
+exact, a saturated trie no longer wedges dispatch (the sim-discovered
+livelock), and admission pins matched prefix nodes before evicting
+under pressure."""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from distributed_training_sandbox_tpu.serving import (
+    ContinuousBatcher, PageAllocator, Request)
+from distributed_training_sandbox_tpu.serving.kv_pool import (
+    RadixPrefixCache)
+from distributed_training_sandbox_tpu.serving.router import (
+    AdmissionController)
+from distributed_training_sandbox_tpu.serving.scheduler import WAITING
+from distributed_training_sandbox_tpu.serving.traces import (
+    build_fleet_trace, build_tenant_trace, build_trace, trace_digest)
+from distributed_training_sandbox_tpu.sim import (SimCostModel,
+                                                  simulate_trace)
+
+pytestmark = pytest.mark.sim
+
+SCRIPTS = Path(__file__).resolve().parent.parent / "scripts"
+
+
+def _load_script(name: str):
+    spec = importlib.util.spec_from_file_location(
+        f"_sim_test_{name[:-3]}", SCRIPTS / name)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---- satellite: one trace generator, two substrates ---------------------
+
+def test_trace_byte_identity_across_interfaces():
+    """serve_bench's historical triple interface and the simulator's
+    TraceRequest interface must draw the SAME stream from one seed —
+    the digest is the contract, not the source text."""
+    kw = dict(vocab=256, max_seq_len=80)
+    triples = build_trace(np.random.default_rng(7), 200, 16.0,
+                          kw["vocab"], kw["max_seq_len"])
+    records = build_tenant_trace(np.random.default_rng(7), 200, 16.0,
+                                 kw["vocab"], kw["max_seq_len"])
+    assert trace_digest(triples) == trace_digest(records)
+
+
+def test_serve_bench_delegate_draws_identical_trace():
+    sb = _load_script("serve_bench.py")
+    a = sb.build_trace(np.random.default_rng(3), 64, 16.0, 256, 80,
+                       tenants=4, overlap_frac=0.6)
+    b = build_trace(np.random.default_rng(3), 64, 16.0, 256, 80,
+                    tenants=4, overlap_frac=0.6)
+    assert trace_digest(a) == trace_digest(b)
+
+
+def test_trace_generator_golden_digest():
+    """Drift pin: any change to the draw order/distributions breaks
+    seed-reproducibility claims across recorded runs — this digest only
+    moves with an intentional, documented generator change."""
+    t = build_tenant_trace(np.random.default_rng(0), 64, 16.0, 256, 80,
+                           tenants=4, overlap_frac=0.6, sys_len=16)
+    assert trace_digest(t) == ("6e3e21f95554d0b602259452f2e1b761"
+                               "e6a008366f1fd5702f69a741aae4aacf")
+
+
+def test_fleet_trace_seeded_and_shaped():
+    mk = lambda seed: build_fleet_trace(
+        np.random.default_rng(seed), 5000, base_rate=100.0, vocab=256,
+        max_seq_len=80, tenants=8, tenant_skew=1.2,
+        flash_crowds=((5.0, 5.0, 3.0),))
+    a, b, c = mk(1), mk(1), mk(2)
+    assert trace_digest(a) == trace_digest(b)
+    assert trace_digest(a) != trace_digest(c)
+    # Zipf skew: tenant 0 (the whale) strictly dominates the tail
+    counts = np.bincount([r.tenant for r in a], minlength=8)
+    assert counts[0] > counts[-1]
+    # arrivals strictly ordered (non-homogeneous Poisson, still a
+    # point process)
+    ts = [r.arrival_s for r in a]
+    assert all(t1 < t2 for t1, t2 in zip(ts, ts[1:]))
+
+
+# ---- determinism: the digest pin ----------------------------------------
+
+_SIM_ENG = dict(max_batch=2, page_size=8, max_seq_len=32,
+                prefill_chunk=8, sync_every=2)
+
+
+def _sim(trace, **kw):
+    base = dict(replicas=2, engine_kwargs=_SIM_ENG)
+    base.update(kw)
+    return simulate_trace(trace, **base)
+
+
+def test_sim_digest_deterministic():
+    trace = build_tenant_trace(np.random.default_rng(11), 2000, 50.0,
+                               256, 32, tenants=4, overlap_frac=0.5)
+    a, b = _sim(trace), _sim(trace)
+    assert a.digest() == b.digest()
+    assert len(a.completed) == len(b.completed) > 0
+
+
+def test_shed_set_seed_reproducible_at_scale():
+    """10^4 offered requests under overload: the full structured shed
+    set — every (rid, reason) — reproduces bit-for-bit run to run."""
+    trace = build_tenant_trace(np.random.default_rng(5), 10_000, 400.0,
+                               256, 32, tenants=6, overlap_frac=0.5)
+    kw = dict(deadline_s=0.4, fleet_kwargs={"max_queue": 4})
+    a, b = _sim(trace, **kw), _sim(trace, **kw)
+    shed_a = [(r.rid, r.reason) for r in a.router.rejections]
+    shed_b = [(r.rid, r.reason) for r in b.router.rejections]
+    assert shed_a == shed_b
+    assert len(shed_a) > 0                 # overload actually shed
+    assert a.digest() == b.digest()
+    # conservation: every offered request is accounted for exactly once
+    assert len(a.completed) + len(shed_a) == 10_000
+    assert a.dropped() == []
+
+
+# ---- satellite: admission-prior edge cases ------------------------------
+
+def test_service_round_floor_at_full_hit_rate():
+    """A perfect prefix cache discounts the modeled service round, but
+    never below the floor: the last prompt page is always prefilled
+    for the first-token logits, so modeled TTFT stays positive."""
+    adm = AdmissionController(4, burst_s=0.1)
+    for _ in range(200):                   # EWMA → asymptotically 1.0
+        adm.note_cache_hit_rate(1.0)
+    assert adm.cache_hit_rate > 0.99
+    reason, modeled, _ = adm.offer(0.0, max_new_tokens=4)
+    assert reason is None
+    assert modeled == pytest.approx(0.25 * adm.burst_s)
+
+
+def test_ewma_burst_convergence():
+    adm = AdmissionController(4, burst_s=0.05)
+    for _ in range(100):
+        adm.observe_burst(0.2)
+    assert adm.burst_s == pytest.approx(0.2, rel=1e-6)
+    # nonpositive observations are ignored, not absorbed
+    adm.observe_burst(0.0)
+    adm.observe_burst(-1.0)
+    assert adm.burst_s == pytest.approx(0.2, rel=1e-6)
+
+
+def test_frozen_prior_ignores_feedback():
+    adm = AdmissionController(4, burst_s=0.05, calibrate=False)
+    adm.observe_burst(5.0)
+    adm.note_cache_hit_rate(1.0)
+    assert adm.burst_s == 0.05 and adm.cache_hit_rate == 0.0
+
+
+# ---- cost-model calibration ---------------------------------------------
+
+def test_cost_model_from_summary_totals():
+    summary = {"fleet": {"replica_slo": [
+        {"scheduler": {"rounds": 10, "prefill_chunks": 20,
+                       "decode_steps": 40, "admit_ms_total": 2.0,
+                       "prefill_ms_total": 160.0,
+                       "decode_ms_total": 200.0}}]}}
+    cm = SimCostModel.from_summary(summary, source="test")
+    assert cm.admit_s == pytest.approx(2e-4)
+    assert cm.prefill_chunk_s == pytest.approx(8e-3)
+    assert cm.decode_step_s == pytest.approx(5e-3)
+    assert cm.source == "test"
+    assert SimCostModel.from_dict(cm.to_dict()) == cm
+
+
+def test_cost_model_refuses_summary_without_totals():
+    with pytest.raises(ValueError, match="scheduler block"):
+        SimCostModel.from_summary({"serving": {}})
+
+
+# ---- chaos on the virtual clock -----------------------------------------
+
+def test_failover_completes_every_submitted_request():
+    """A mid-trace replica kill on the virtual clock: orphans replay on
+    the survivor, zero admitted requests drop, and the event timeline
+    records the blind window (fault → detection)."""
+    trace = build_tenant_trace(np.random.default_rng(9), 300, 50.0,
+                               256, 32, tenants=3, overlap_frac=0.5)
+    fleet = _sim(trace, kills=((1.0, 1),))
+    assert fleet.dropped() == []
+    assert len(fleet.completed) + len(fleet.router.rejections) == 300
+    evs = [e["event"] for e in fleet.events]
+    assert "replica_fault_injected" in evs and "replica_dead" in evs
+    t_fault = next(e["t_s"] for e in fleet.events
+                   if e["event"] == "replica_fault_injected")
+    t_dead = next(e["t_s"] for e in fleet.events
+                  if e["event"] == "replica_dead")
+    # events are drained at round boundaries, so the observed blind
+    # window is the detection delay quantized to round granularity
+    assert t_dead - t_fault == pytest.approx(
+        fleet.cost.failover_detect_s, abs=0.1)
+
+
+def test_attainment_curves_monotone_and_tenants_reported():
+    trace = build_fleet_trace(np.random.default_rng(13), 5000,
+                              base_rate=150.0, vocab=256,
+                              max_seq_len=32, tenants=6,
+                              tenant_skew=1.3)
+    fleet = _sim(trace, deadline_s=1.0,
+                 fleet_kwargs={"max_queue": 6})
+    rep = fleet.slo_report()
+    curve = rep["attainment"]["overall"]
+    assert all(a <= b + 1e-12 for a, b in zip(curve, curve[1:]))
+    assert curve[-1] <= 1.0
+    assert len(rep["tenants"]) == 6
+    fair = rep["fairness"]
+    assert fair["jain_attainment"] is None \
+        or 0.0 < fair["jain_attainment"] <= 1.0
+    assert fair["worst_tenant"]["attainment"] == min(
+        t["attainment"] for t in rep["tenants"].values())
+
+
+# ---- the sim-discovered livelock + its real-code fixes ------------------
+
+def test_reclaimable_pages_counter_exact():
+    """The O(1) counter must equal a full refs-0 walk after any mix of
+    insert / acquire / release / evict — ``can_accept`` trusts it."""
+    alloc = PageAllocator(16)
+    cache = RadixPrefixCache(alloc, page_size=4)
+
+    def check():
+        assert cache.reclaimable_pages == sum(
+            1 for n in cache._nodes if n.refs == 0)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 50, size=13).astype(np.int32)
+               for _ in range(3)]
+    held = []
+    for pr in prompts:
+        pages = alloc.alloc(3)
+        nodes, _ = cache.insert(pr, pages, [])
+        check()
+        held.append(nodes)
+    # release some holders → their unique suffix nodes go refs-0
+    cache.release(held[0])
+    check()
+    cache.release(held[1])
+    check()
+    # re-acquire a prefix, evict under pressure, release everything
+    again = cache.match(prompts[0])
+    cache.acquire(again)
+    check()
+    cache.evict(2)
+    check()
+    cache.release(again)
+    cache.release(held[2])
+    check()
+    cache.evict(99)
+    check()
+    assert cache.cached_pages == 0 and cache.reclaimable_pages == 0
+
+
+def test_saturated_prefix_cache_does_not_wedge():
+    """Regression for the livelock the simulator found in the REAL
+    engine: a trie that has grown to own (almost) the whole pool used
+    to fail ``can_accept`` forever — free_pages alone never covers a
+    grant — while every replica sat idle.  With the evictable-page
+    credit the run drains; eviction under pressure proves the trie
+    really was saturated."""
+    rng = np.random.default_rng(21)
+    # 10-token prompts (2 cacheable full pages each, mostly distinct)
+    # at a rate that keeps the queue fed — the trie grows monotonically
+    # toward pool ownership
+    t = 0.0
+    trace = []
+    for _ in range(120):
+        t += float(rng.exponential(1.0 / 200.0))
+        trace.append((t, rng.integers(1, 256, size=10)
+                      .astype(np.int32), 4))
+    fleet = simulate_trace(
+        trace, replicas=2,
+        engine_kwargs=dict(max_batch=2, page_size=4, max_seq_len=16,
+                           prefill_chunk=4, sync_every=2,
+                           prefix_cache=True))
+    assert fleet.dropped() == []
+    assert (len(fleet.completed)
+            + len(fleet.router.rejections)) == 120
+    assert any(r.engine.prefix_cache.evictions > 0
+               for r in fleet.replicas)
+
+
+def test_admit_pins_matched_prefix_before_evicting():
+    """Under pool pressure the admit path evicts refs-0 pages — but
+    the request's own matched prefix is refs-0 too at that instant.
+    Evicting it would hand the request a freed page it is about to
+    alias.  The pin makes those nodes untouchable: with nothing else
+    evictable the request must WAIT, trie intact."""
+    alloc = PageAllocator(8)
+    cache = RadixPrefixCache(alloc, page_size=4)
+    b = ContinuousBatcher(2, alloc, page_size=4)
+    b.prefix_cache = cache
+    prompt = np.arange(1, 13, dtype=np.int32)        # 12 tokens
+    # seed the trie: request A runs to completion and donates 2 pages
+    a = Request(rid=0, prompt=prompt, max_new_tokens=4)
+    b.submit(a, now=0.0)
+    assert b.admit(now=0.0) == [a]
+    nodes, _ = cache.insert(a.prompt, a.pages, a.cache_nodes)
+    a.cache_nodes = nodes
+    b.retire(a, now=1.0)
+    assert cache.cached_pages == 2 and cache.reclaimable_pages == 2
+    # exhaust the allocator so B's 2-page suffix grant needs eviction
+    hog = alloc.alloc(alloc.free_pages)
+    assert alloc.free_pages == 0
+    req = Request(rid=1, prompt=prompt.copy(), max_new_tokens=4)
+    b.submit(req, now=2.0)
+    assert b.admit(now=2.0) == []
+    assert req.state == WAITING
+    # the matched prefix survived: same nodes, same pages
+    m = cache.match(prompt)
+    assert [n.page for n in m] == [n.page for n in nodes]
+    # pressure released → B admits aliasing the cached prefix
+    alloc.free(hog)
+    assert b.admit(now=3.0) == [req]
+    assert req.pages[:2] == [n.page for n in nodes]
+    assert len(set(req.pages)) == len(req.pages)
+
+
+# ---- THE validation gate: sim vs real serve_bench fleet -----------------
+
+def test_sim_validates_against_real_fleet():
+    """Replay one matched trace through the real ``serving.Fleet`` and
+    through the sim calibrated from that very run.  The control plane
+    is shared code and submissions precede run() on both substrates,
+    so the shed set must match EXACTLY; TTFT percentiles must land
+    within a calibrated multiplicative band (real stamps include the
+    JIT compile at the trace head, which calibration smears over every
+    chunk — measured ratio ≈2.3x cold, ≈1x warm; the band bounds
+    both)."""
+    import jax
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.serving import Fleet, Rejection
+
+    cfg = T.TINY_LM
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    trace = build_tenant_trace(np.random.default_rng(42), 40, 60.0,
+                               cfg.vocab_size, 32, tenants=3,
+                               overlap_frac=0.5)
+    backoff = 0.05
+    fleet = Fleet(params, cfg, replicas=2, watchdog_timeout_s=0.0,
+                  max_queue=4, burst_s_prior=backoff, **_SIM_ENG)
+    offset = 0.0
+    for rec in trace:
+        r = fleet.submit(rec.prompt, max_new_tokens=rec.max_new,
+                         arrival_s=rec.arrival_s + offset,
+                         deadline_s=4.0)
+        if isinstance(r, Rejection) and r.reason == "queue_full":
+            offset += backoff
+    fleet.run()
+    real = fleet.slo_report()
+
+    cost = SimCostModel.from_fleet(fleet)
+    sim = simulate_trace(
+        trace, cost=cost, replicas=2, deadline_s=4.0,
+        backoff_s=backoff,
+        fleet_kwargs={"max_queue": 4, "burst_s_prior": backoff},
+        engine_kwargs=_SIM_ENG)
+    rep = sim.slo_report()
+
+    # the policy decisions are EXACT — same code, same prior stream
+    real_shed = {(r.rid, r.reason) for r in fleet.router.rejections}
+    sim_shed = {(r.rid, r.reason) for r in sim.router.rejections}
+    assert real_shed == sim_shed
+    assert rep["completed"] == real["completed"]
+    assert rep["dropped"] == real["dropped"] == 0
+
+    # the timing model agrees within the calibrated band
+    BAND = 4.0
+    for q in ("p50", "p99"):
+        rv, sv = real["ttft_ms"][q], rep["ttft_ms"][q]
+        assert (rv is None) == (sv is None)
+        if rv is not None:
+            ratio = rv / sv
+            assert 1.0 / BAND <= ratio <= BAND, \
+                f"TTFT {q}: real {rv} ms vs sim {sv} ms (x{ratio:.2f})"
+
+
+# ---- policy evaluation: simrank + prerank file --------------------------
+
+def test_sim_rank_serving_and_prerank_roundtrip(tmp_path):
+    from distributed_training_sandbox_tpu.tuner import (
+        ServingKnobSpace, load_prerank, sim_rank_serving, write_prerank)
+    space = ServingKnobSpace(max_batch=(2, 4), page_size=(8,),
+                             prefill_chunk=(8,), sync_every=(2,),
+                             spec_k=(0, 2), draft_layers=(1, 2))
+    trace = build_tenant_trace(np.random.default_rng(1), 300, 80.0,
+                               256, 32, tenants=3, overlap_frac=0.5)
+    ranked = sim_rank_serving(space, trace, replicas=2, max_seq_len=32)
+    # 2 batch x 2 spec = 4 sim-distinct rows; the spec_k=2 rows absorb
+    # their draft_layers=2 twin (the sim cannot price draft depth)
+    assert len(ranked) == 4
+    assert [r["rank"] for r in ranked] == [0, 1, 2, 3]
+    objs = [r["objective"] for r in ranked]
+    assert objs == sorted(objs)
+    twins = [r for r in ranked if r["sim_twins"]]
+    assert all(r["knobs"]["spec_k"] for r in twins)
+
+    path = tmp_path / "sim_prerank.json"
+    write_prerank(path, ranked, space)
+    doc = load_prerank(path, space=space)
+    assert doc["space_hash"] == space.space_hash()
+    assert doc["candidates"][0]["digest"] == ranked[0]["digest"]
+    other = ServingKnobSpace(max_batch=(8,))
+    with pytest.raises(ValueError, match="space"):
+        load_prerank(path, space=other)
+
+
+def test_sim_bench_smoke_cli():
+    sb = _load_script("sim_bench.py")
+    assert sb.main(["--smoke", "--requests", "300", "--seed", "5",
+                    "--max-seq-len", "32", "--max-batch", "2",
+                    "--page-size", "8", "--prefill-chunk", "8",
+                    "--sync-every", "2"]) == 0
+
+
+# ---- satellite: the registry never mixes substrates ---------------------
+
+def _fake_run(root: Path, run_id: str, *, sim: bool) -> Path:
+    d = root / run_id
+    d.mkdir()
+    man = {"run_id": run_id, "strategy": "sim" if sim else "fleet",
+           "model": "TINY_LM", "started_utc": "2026-08-07T00:00:00Z",
+           "device_count": 8,
+           "config": ({"substrate": "sim", "seed": 0} if sim
+                      else {"seed": 0})}
+    summ = {"status": "completed", "step_time_ms": 10.0}
+    if sim:
+        summ["sim"] = {"offered": 10, "completed": 10}
+    (d / "manifest.json").write_text(json.dumps(man))
+    (d / "summary.json").write_text(json.dumps(summ))
+    return d
+
+
+def test_registry_marks_sim_and_diff_refuses_mixed(tmp_path):
+    runs = _load_script("runs.py")
+    conn = runs.connect(str(tmp_path / "runs.sqlite"))
+    root = tmp_path / "runs"
+    root.mkdir()
+    runs.index_run_dir(conn, str(_fake_run(root, "r-real", sim=False)))
+    runs.index_run_dir(conn, str(_fake_run(root, "r-sim", sim=True)))
+    rows = {r["run_id"]: r["sim"] for r in conn.execute(
+        "SELECT run_id, sim FROM runs")}
+    assert rows == {"r-real": 0, "r-sim": 1}
+    with pytest.raises(ValueError, match="substrate mismatch"):
+        runs.diff_runs(conn, "r-real", "r-sim")
+    out = runs.diff_runs(conn, "r-real", "r-sim",
+                         allow_mixed_substrates=True)
+    assert out["substrate_mismatch"] is True
+    assert out["substrates"] == {"baseline": "real", "current": "sim"}
+    # like-for-like diffs stay silent
+    same = runs.diff_runs(conn, "r-real", "r-real")
+    assert same["substrate_mismatch"] is False
+    conn.close()
