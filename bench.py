@@ -189,17 +189,29 @@ KNOB_MATRIX = [
     # interpret mode off-TPU; bitwise vs explicit_ring_fused either way)
     ("explicit_ring_fused_pallas", {}, {"reshard_after_forward": True,
                                         "overlap": "ring_fused_pallas"}, 1),
+    # r7: the composable 3-axis combo (strategy composable_dp_fsdp_tp —
+    # parallel/composable.py rule-driven dp2×fsdp2×tp2 step) as a matrix
+    # row.  The _mesh{D}x{F}x{T} token round-trips through
+    # parse_bench_config_name, so this row joins the tuner's prior pool
+    # as a mesh-axis candidate; needs exactly 8 devices (skipped as
+    # infeasible elsewhere), pre-flighted through the mesh-aware
+    # analytic waterline like every other row.
+    ("explicit_mesh2x2x2", {}, {"reshard_after_forward": True}, 1,
+     {"mesh_shape": (2, 2, 2)}),
 ]
 
 
 def measure(model_name: str, seq: int, batch: int, num_steps: int = 8,
             cfg_overrides: dict | None = None,
             step_kwargs: dict | None = None,
-            sync_each_step: bool = False):
+            sync_each_step: bool = False,
+            mesh_shape: tuple | None = None):
     """Time one knob configuration; ``step_kwargs=None`` selects the
     pjit-auto variant, a dict the explicit shard_map one.
     ``sync_each_step`` re-adds the per-step host sync (the pre-pump loop
-    shape) for the pump on/off A/B."""
+    shape) for the pump on/off A/B.  ``mesh_shape`` (dp, fsdp, tp)
+    switches the row from the flat-dp fsdp step to the composable
+    3-axis step (``parallel.composable``) on that named mesh."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -212,6 +224,31 @@ def measure(model_name: str, seq: int, batch: int, num_steps: int = 8,
     cfg = getattr(T, model_name)
     if cfg_overrides:
         cfg = dataclasses.replace(cfg, **cfg_overrides)
+    if mesh_shape:
+        from distributed_training_sandbox_tpu.parallel.composable import (
+            MeshPlan, make_composable_train_step)
+        sk = dict(step_kwargs or {})
+        sk.pop("reshard_after_forward", None)  # the 3-axis step's default
+        unsupported = set(sk) - {"accum_steps", "overlap"}
+        if unsupported:
+            raise ValueError(f"mesh_shape rows compose accum/overlap "
+                             f"only; got {sorted(unsupported)}")
+        dp, f, tp = (tuple(mesh_shape) + (1, 1, 1))[:3]
+        plan = MeshPlan(dp=dp, fsdp=f, tp=tp)
+        plan.validate(len(jax.devices()), cfg)
+        mesh = make_mesh({"dp": dp, "fsdp": f, "tp": tp})
+        ws = int(mesh.devices.size)
+        batch = -(-batch // plan.data_ways) * plan.data_ways
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        build = make_composable_train_step(params, plan, mesh,
+                                           model_cfg=cfg, **sk)
+        del params
+        shards, opt, step = build.params, build.opt_state, build.step
+        ids = jnp.zeros((batch, seq), jnp.int32)
+        batch_arrs = (ids, ids)
+        return _timed_rows(model_name, seq, batch, num_steps, cfg, mesh,
+                           ws, step, shards, opt, batch_arrs,
+                           sync_each_step)
     mesh = make_mesh()
     ws = int(mesh.devices.size)
     batch = -(-batch // ws) * ws  # round up to a multiple of the mesh
@@ -228,7 +265,18 @@ def measure(model_name: str, seq: int, batch: int, num_steps: int = 8,
         step = fsdp.make_fsdp_train_step(shards, cfg, mesh, **step_kwargs)
     ids = jnp.zeros((batch, seq), jnp.int32)
     batch_arrs = (ids, ids)
+    return _timed_rows(model_name, seq, batch, num_steps, cfg, mesh, ws,
+                       step, shards, opt, batch_arrs, sync_each_step)
 
+
+def _timed_rows(model_name, seq, batch, num_steps, cfg, mesh, ws, step,
+                shards, opt, batch_arrs, sync_each_step):
+    """measure()'s shared timed loop: warmups, the timed window, the row
+    dict, and the per-row collective ledger."""
+    import jax
+    import numpy as np
+    from distributed_training_sandbox_tpu.utils.flops import (
+        get_model_flops_per_token)
     # Two warmups: call 1 compiles; call 2 can recompile when jit picks
     # output shardings that differ from the input commitment.
     for _ in range(2):
@@ -348,10 +396,13 @@ def _gate_ledger_rows(rows: list[dict]) -> None:
 
 def predict_row_gb(model_name: str, seq: int, batch: int,
                    cfg_overrides: dict | None,
-                   step_kwargs: dict | None) -> float | None:
+                   step_kwargs: dict | None,
+                   mesh_shape: tuple | None = None) -> float | None:
     """Analytic per-device waterline for one matrix row — the planner's
     pre-flight, microseconds instead of the compile that would OOM.
-    None for the pjit-auto rows (XLA owns their buffer plan)."""
+    None for the pjit-auto rows (XLA owns their buffer plan).  Mesh rows
+    are priced under their own MeshPlan (params/opt/batch divided by the
+    plan's shard ways, not flat dp)."""
     import jax
     from distributed_training_sandbox_tpu.memory_plan import (
         analytic_waterline)
@@ -362,10 +413,19 @@ def predict_row_gb(model_name: str, seq: int, batch: int,
     if cfg_overrides:
         cfg = dataclasses.replace(cfg, **cfg_overrides)
     ws = len(jax.devices())
-    batch = -(-batch // ws) * ws
+    mesh_plan = None
+    if mesh_shape:
+        from distributed_training_sandbox_tpu.parallel.composable import (
+            MeshPlan)
+        dp, f, tp = (tuple(mesh_shape) + (1, 1, 1))[:3]
+        mesh_plan = MeshPlan(dp=dp, fsdp=f, tp=tp)
+        batch = -(-batch // mesh_plan.data_ways) * mesh_plan.data_ways
+    else:
+        batch = -(-batch // ws) * ws
     pred = analytic_waterline(
         cfg, batch=batch, seq=seq, ws=ws,
-        state_precision=step_kwargs.get("state_precision", "full"))
+        state_precision=step_kwargs.get("state_precision", "full"),
+        mesh_plan=mesh_plan)
     return round(pred.gb, 2)
 
 
@@ -419,7 +479,9 @@ def _autotuned_row(model_name: str, seq: int, base_batch: int,
             batch_scale=knobs["batch_scale"],
             remat_policy=knobs["remat_policy"],
             matmul_precision=knobs["matmul_precision"],
-            state_precision=knobs["state_precision"]), r)
+            state_precision=knobs["state_precision"],
+            mesh_shape=(tuple(knobs["mesh_shape"])
+                        if knobs.get("mesh_shape") else None)), r)
         if r.get("tflops_per_device"):
             priors.append({**r, "knobs": knobs})
     if not covered:
@@ -453,12 +515,25 @@ def run_matrix(model_name: str, seq: int, base_batch: int):
     no runtime OOM); rows that still fail record a structured error."""
     from distributed_training_sandbox_tpu.utils.memory import (
         hbm_capacity_gb)
+    import jax
     rows = []
     capacity = hbm_capacity_gb()
     for name, cfg_over, step_kw, bscale, *mk in KNOB_MATRIX:
+        mkw = mk[0] if mk else {}
+        mesh_shape = mkw.get("mesh_shape")
+        if mesh_shape:
+            dims = (tuple(mesh_shape) + (1, 1, 1))[:3]
+            if dims[0] * dims[1] * dims[2] != len(jax.devices()):
+                rows.append({"config": name,
+                             "skipped": "infeasible_mesh",
+                             "mesh_shape": list(dims),
+                             "devices": len(jax.devices())})
+                print(f"[bench] {rows[-1]}", file=sys.stderr, flush=True)
+                continue
         try:
             pred = predict_row_gb(model_name, seq, base_batch * bscale,
-                                  cfg_over, step_kw)
+                                  cfg_over, step_kw,
+                                  mesh_shape=mesh_shape)
         except Exception:  # noqa: BLE001 - prediction must not kill the bench
             pred = None
         if pred is not None and capacity is not None and pred > capacity:

@@ -155,6 +155,22 @@ def _sp_counts(rs: RuleSet) -> Callable[[ContractContext], dict]:
     return counts
 
 
+def _composable_counts(rs: RuleSet) -> Callable[[ContractContext], dict]:
+    """dp×fsdp×tp (``parallel.composable._make_dp_fsdp_tp_step``): the
+    fsdp mechanism contributes one gather + one reduce-scatter site per
+    stacked leaf (scan collapses depth, backward re-gathers share the
+    forward sites); the tp layer body its 2 rejoin psums; the grad sync
+    one fused psum per leaf over the axes it is replicated on; plus the
+    loss pmean.  The rejoin/pmean psums fuse unpredictably across
+    remat boundaries, hence the range on all_reduce (mirroring the hand
+    tp family's calibration)."""
+    def counts(c: ContractContext) -> dict:
+        n = c.n_leaves
+        return {"all_reduce": (n + 1, n + 8), "all_gather": n,
+                "reduce_scatter": n}
+    return counts
+
+
 def _moe_counts(rs: RuleSet) -> Callable[[ContractContext], dict]:
     """Switch-MoE: a2a dispatch + return in the scanned body, each with
     its backward transpose (4 sites); dense/router grads psum'd."""
@@ -182,6 +198,7 @@ _FAMILY_COUNTS = {
     "fsdp": _fsdp_counts,
     "tp": _tp_counts,
     "sp": _sp_counts,
+    "composable": _composable_counts,
     "moe": _moe_counts,
     "serve": _serve_counts,
     "pipeline": _pipeline_counts,
@@ -284,6 +301,11 @@ def _context_grid(strategy: str) -> list[ContractContext]:
     elif rs.family == "sp":
         for dp, sp in ((2, 4), (4, 2)):
             ctx({"dp": dp, "sp": sp}, n_leaves=13)
+    elif rs.family == "composable":
+        for dp, f, tp in ((2, 2, 2), (1, 2, 2), (2, 4, 2), (2, 2, 4)):
+            for n, L in ((11, 2), (11, 4)):
+                ctx({"dp": dp, "fsdp": f, "tp": tp}, n_leaves=n,
+                    n_layers=L)
     elif rs.family == "moe":
         for dp, ep in ((2, 4), (4, 2)):
             ctx({"dp": dp, "ep": ep}, n_leaves=16)
@@ -388,3 +410,18 @@ def diff_all_contracts() -> dict[str, ContractDiff]:
         else:
             out[strategy] = diff_contract(strategy)
     return out
+
+
+# --------------------------------------------------- generated registry
+#
+# The composable mesh driver's strategies have NO hand-written contract
+# by design (the tentpole of ROADMAP item 1): their registry entry IS
+# the generated one, installed at import time so evaluate_contract /
+# hlo_lint / the drift differ see them exactly like any calibrated
+# strategy.  diff_contract for these trivially agrees — the point is
+# that the formula's provenance is the RuleSet, not a calibration pass.
+GENERATED_STRATEGIES = ("composable_zero1", "composable_dp_fsdp_tp")
+
+for _name in GENERATED_STRATEGIES:
+    CONTRACTS[_name] = generate_contract(_name)
+del _name

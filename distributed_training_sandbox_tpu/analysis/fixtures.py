@@ -242,6 +242,65 @@ def _build_transformer(strategy: str, *, mesh=None, scale: int = 100,
                          full_param_shapes=shapes)
 
 
+@register_strategy("composable_zero1", "composable_dp_fsdp_tp")
+def _build_composable(strategy: str, *, mesh=None, scale: int = 100,
+                      seq: int = 32,
+                      batch_size: int = 8) -> StrategyBuild:
+    """MeshPlan-driven builds through ``make_composable_train_step`` —
+    the generated-contract strategies.  ``composable_zero1`` is the toy
+    MLP at W1 over flat dp (zero1's bitwise twin through the composable
+    surface); ``composable_dp_fsdp_tp`` is TINY_LM on the 3-axis
+    dp×fsdp×tp mesh, placement from its RuleSet."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import transformer as T, zero_toy_mlp
+    from ..models.mlp import mse_loss
+    from ..parallel.composable import MeshPlan, make_composable_train_step
+    from ..utils import make_mesh, set_seed
+    from .hlo_lint import param_shapes
+
+    key = set_seed(0)
+    n_dev = len(jax.devices())
+    if strategy == "composable_zero1":
+        mesh = mesh or make_mesh(register=False)
+        params = zero_toy_mlp(key, scale=scale)
+        plan = MeshPlan(dp=int(mesh.shape["dp"]), w=1)
+        build = make_composable_train_step(params, plan, mesh,
+                                           loss_fn=mse_loss)
+        width = 10_000 // scale
+        kx, ky = jax.random.split(key)
+        b = (jax.random.normal(kx, (batch_size, width)),
+             jax.random.normal(ky, (batch_size, width)))
+        shapes = param_shapes(params, min_numel=256)
+        ctx = ContractContext.capture(params=params, mesh=mesh,
+                                      n_layers=len(params),
+                                      **build.contract_kwargs)
+    else:
+        if mesh is None:
+            if n_dev < 8:
+                raise RuntimeError(
+                    f"{strategy} fixture needs >= 8 devices "
+                    f"(have {n_dev})")
+            mesh = make_mesh({"dp": n_dev // 4, "fsdp": 2, "tp": 2},
+                             register=False)
+        mcfg = T.TINY_LM
+        params = T.init_params(key, mcfg)
+        plan = MeshPlan(dp=int(mesh.shape["dp"]),
+                        fsdp=int(mesh.shape["fsdp"]),
+                        tp=int(mesh.shape["tp"]))
+        build = make_composable_train_step(params, plan, mesh,
+                                           model_cfg=mcfg)
+        b = (jnp.zeros((batch_size, seq), jnp.int32),) * 2
+        shapes = param_shapes(params, min_numel=1024)
+        ctx = ContractContext.capture(params=params, mesh=mesh,
+                                      **build.contract_kwargs)
+    return StrategyBuild(strategy, build.step,
+                         (build.params, build.opt_state, b),
+                         _state_advance, mesh, ctx, donate=True,
+                         full_param_shapes=shapes)
+
+
 @register_strategy("serve_decode", "serve_decode_paged_kernel")
 def _build_serve_decode(strategy: str, *, mesh=None, scale: int = 100,
                         seq: int = 32,

@@ -466,6 +466,44 @@ def serve_ruleset(strategy: str, *, axis: str = "tp",
                     + (", paged-attention kernel" if paged_kernel else ""))
 
 
+def composable_ruleset(strategy: str, *, dp_axis: str = "dp",
+                       fsdp_axis: str = "fsdp", tp_axis: str = "tp",
+                       overlap: str = "none") -> RuleSet:
+    """The 3-axis dp×fsdp×tp combo of the composable mesh driver
+    (``parallel.composable``): Megatron column/row tp roles on the
+    projection dim each leaf contracts LAST, named-dim W3 fsdp sharding
+    on the other — column-parallel ``(L, in⊘fsdp, out⊘tp)``,
+    row-parallel ``(L, in⊘tp, out⊘fsdp)`` — norms and plain leaves
+    fsdp-only, the batch jointly over ``(dp, fsdp)`` (both carry data;
+    tp sees replicas, exactly as in the 2-D tp family)."""
+    col = "|".join(TP_COL_LEAVES)
+    row = "|".join(TP_ROW_LEAVES)
+    param_rules = (
+        Rule(rf"^layers/({col})$", (None, fsdp_axis, tp_axis),
+             "column-parallel (L, in, out): fsdp shards in, tp shards "
+             "out"),
+        Rule(rf"^layers/({row})$", (None, tp_axis, fsdp_axis),
+             "row-parallel (L, in, out): tp shards in, fsdp shards out"),
+        Rule(r"^layers/", (None, fsdp_axis),
+             "other stacked leaves (norms): fsdp shards dim 1"),
+        Rule(r".*", (fsdp_axis,),
+             "plain leaves (embed, final_norm): fsdp shards dim 0"),
+    )
+    return RuleSet(
+        strategy=strategy, family="composable",
+        axes=(dp_axis, fsdp_axis, tp_axis),
+        param_rules=param_rules,
+        opt_rules=mirror_opt_rules(param_rules),
+        batch_rules=(Rule(r".*", ((dp_axis, fsdp_axis),),
+                          "batch over the flattened (dp, fsdp) axis, "
+                          "replicated over tp"),),
+        weight_update_sharding=3,
+        config={"overlap": overlap},
+        description="composable dp×fsdp×tp (named-dim W3 × megatron tp)"
+                    + (f", overlap={overlap}" if overlap != "none"
+                       else ""))
+
+
 def pipeline_ruleset(strategy: str, *, schedule: str | None = None
                      ) -> RuleSet:
     """Pipeline stages are single-device jitted programs: everything
@@ -507,6 +545,14 @@ RULESETS: dict[str, RuleSet] = {
         "serve_prefill_flash", paged_kernel=True, step="prefill_flash"),
     "gpipe": pipeline_ruleset("gpipe"),
     "1f1b": pipeline_ruleset("1f1b"),
+    # composable mesh driver (parallel/composable.py): contracts for
+    # these are GENERATED from the rules by contract_gen at import time,
+    # never hand-registered — composable_zero1 is the legacy-replay
+    # exemplar (same wire choreography as zero1, generated contract),
+    # composable_dp_fsdp_tp the genuinely new 3-axis combo.
+    "composable_zero1": data_parallel_ruleset(
+        "composable_zero1", weight_update_sharding=1),
+    "composable_dp_fsdp_tp": composable_ruleset("composable_dp_fsdp_tp"),
 }
 
 
@@ -532,6 +578,8 @@ RULE_COVERED_MODULE_STEMS = frozenset({
     # scripts/ drivers of contracted strategies
     "zero1", "zero2", "zero3", "_zero_driver", "train_fsdp",
     "train_tp", "train_sp", "train_moe", "_2d_driver",
+    # composable mesh driver (MeshPlan -> rule-driven step)
+    "composable", "train_composable",
     # serving decode step builder
     "engine",
 })

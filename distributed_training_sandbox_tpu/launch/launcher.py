@@ -72,6 +72,8 @@ STRATEGY_SCRIPTS = {
     "tp": "train_tp.py",
     "moe": "moe.py",
     "train_moe": "train_moe.py",
+    "composable": "train_composable.py",
+    "train_composable": "train_composable.py",
     "ddp_utilization": "ddp_utilization.py",
 }
 # (ops_demo / long_context / memory_waterline / analyze_results /

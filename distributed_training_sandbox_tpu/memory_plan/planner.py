@@ -186,10 +186,11 @@ def modeled_speed(c: Candidate, prior: dict | None = None) -> float:
 # ---------------------------------------------------------- bench priors
 
 # bench.py row names: explicit[_reshard|_noreshard][_save_*]
-# [_int8(_bwd)|_fp8(_delayed|_pallas)][_s8][_b{N}x] — parsed back into
-# candidate knobs so measured rows can anchor the planner's throughput
-# model.
+# [_int8(_bwd)|_fp8(_delayed|_pallas)][_s8][_b{N}x][_mesh{D}x{F}x{T}] —
+# parsed back into candidate knobs so measured rows can anchor the
+# planner's throughput model.
 _NAME_BSCALE = re.compile(r"_b(\d+)x$")
+_NAME_MESH = re.compile(r"_mesh(\d+(?:x\d+){2,3})")
 
 
 def parse_bench_config_name(name: str) -> dict | None:
@@ -200,12 +201,23 @@ def parse_bench_config_name(name: str) -> dict | None:
     if any(t in name for t in ("syncstep", "ring", "noreshard")):
         return None
     rest = name.removeprefix("explicit").removeprefix("_reshard")
+    # mesh token first: it trails the name, and the batch-scale regex
+    # is end-anchored
+    mesh_shape = None
+    mm = _NAME_MESH.search(rest)
+    if mm:
+        mesh_shape = tuple(int(s) for s in mm.group(1).split("x"))
+        rest = rest[:mm.start()] + rest[mm.end():]
     m = _NAME_BSCALE.search(rest)
     bscale = int(m.group(1)) if m else 1
     if m:
         rest = rest[:m.start()]
     knobs = {"remat_policy": "full", "matmul_precision": "bf16",
              "state_precision": "full", "batch_scale": bscale}
+    if mesh_shape is not None:
+        # only mesh rows carry the key, so legacy names parse to the
+        # exact dict shape they always did; read with .get()
+        knobs["mesh_shape"] = mesh_shape
     if "_s8" in rest:
         knobs["state_precision"] = "int8"
         rest = rest.replace("_s8", "")
@@ -256,10 +268,13 @@ def _find_prior(c: Candidate, priors, per_device_batch: int,
                 base_batch: int | None = None) -> dict | None:
     """Latest measured row with this candidate's exact knobs; prefers a
     matching batch scale when ``base_batch`` is known."""
+    want_mesh = getattr(c, "mesh_shape", None)
     hits = [p for p in priors or [] if p["knobs"]["remat_policy"]
             == c.remat_policy
             and p["knobs"]["matmul_precision"] == c.matmul_precision
-            and p["knobs"]["state_precision"] == c.state_precision]
+            and p["knobs"]["state_precision"] == c.state_precision
+            and (tuple(p["knobs"]["mesh_shape"])
+                 if p["knobs"].get("mesh_shape") else None) == want_mesh]
     if not hits:
         return None
     if base_batch:

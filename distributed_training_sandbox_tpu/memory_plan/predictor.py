@@ -128,7 +128,8 @@ def analytic_waterline(cfg, *, batch: int, seq: int, ws: int = 1,
                        accum_steps: int = 1, state_precision: str = "full",
                        offload: str = "none", dense_grads: bool = True,
                        capacity_gb: float | None = None,
-                       priors: dict | None = None
+                       priors: dict | None = None,
+                       mesh_plan=None
                        ) -> WaterlinePrediction:
     """Tensor-walk waterline model for one FSDP-style train step of
     ``cfg`` (any ``TransformerConfig``-shaped object) at global ``batch``
@@ -147,16 +148,33 @@ def analytic_waterline(cfg, *, batch: int, seq: int, ws: int = 1,
     measured-ledger peak over analytic prediction across indexed runs —
     rescales the total the same way bench priors anchor the tuner, so
     the model recalibrates against ground truth without reweighing its
-    own terms."""
+    own terms.
+
+    ``mesh_plan`` (a ``parallel.composable.MeshPlan`` or anything with
+    its ``param_shard_ways`` / ``opt_shard_ways`` / ``data_ways`` /
+    ``tp`` attributes) replaces the flat-dp assumption: params at rest
+    divide by the plan's param-shard ways (fsdp × tp × dp under W3),
+    optimizer state by its opt-shard ways (W1+), the global batch by the
+    data axes (dp × fsdp), and the per-layer working/saved activations
+    by tp (Megatron shards the projection outputs).  ``mesh_plan=None``
+    keeps the legacy flat-``ws`` law bit-for-bit."""
     itemsize = _dtype_size(getattr(cfg, "dtype", "bfloat16"))
+    if mesh_plan is not None:
+        param_ways = max(int(mesh_plan.param_shard_ways), 1)
+        opt_ways = max(int(mesh_plan.opt_shard_ways), 1)
+        data_ways = max(int(mesh_plan.data_ways), 1)
+        tp_ways = max(int(getattr(mesh_plan, "tp", 1)), 1)
+    else:
+        param_ways = opt_ways = data_ways = ws
+        tp_ways = 1
     P = cfg.param_count() if hasattr(cfg, "param_count") else 0
-    params = P * itemsize / ws
+    params = P * itemsize / param_ways
     grads = params if dense_grads else 0.0
 
     # Adam moments: 2×params at the state dtype ("full" = params' dtype,
     # "int8" = ~1 byte/elem + per-row scales ≈ 9/8 byte).
     state_itemsize = itemsize if state_precision == "full" else 1.125
-    opt = 2 * P * state_itemsize / ws
+    opt = 2 * P * state_itemsize / opt_ways
     if offload in ("opt", "opt_act"):
         # parked on host; device cost = streaming headroom of roughly the
         # largest stacked leaf pair (mu+nu of one projection matrix stack)
@@ -164,9 +182,9 @@ def analytic_waterline(cfg, *, batch: int, seq: int, ws: int = 1,
         biggest = max(
             cfg.hidden_size * cfg.intermediate_size * L,
             cfg.vocab_size * cfg.hidden_size) * state_itemsize
-        opt = 2 * biggest / ws
+        opt = 2 * biggest / opt_ways
 
-    b = max(batch // ws, 1)                     # per-device batch
+    b = max(batch // data_ways, 1)              # per-device batch
     micro = max(b // max(accum_steps, 1), 1)    # per-microbatch rows
     H, L = cfg.hidden_size, cfg.num_hidden_layers
     hd = cfg.head_dim or H // cfg.num_attention_heads
@@ -208,6 +226,10 @@ def analytic_waterline(cfg, *, batch: int, seq: int, ws: int = 1,
     if getattr(cfg, "attention_impl", "xla") == "xla":
         # unfused attention materializes fp32 scores (B, n, S, S)
         working += micro * nq * seq * seq * 4
+    # tp shards every projection output (and its heads) column-wise, so
+    # both the policy-saved dots and the live working set divide by it
+    saved /= tp_ways
+    working /= tp_ways
 
     # loss-phase buffers: streamed vocab chunk (fp32 logits chunk + the
     # checkpointed backward's recompute) or the dense 3-spike trio

@@ -4,6 +4,12 @@ from . import fsdp  # noqa: F401
 from . import sequence  # noqa: F401
 from . import tensor  # noqa: F401
 from . import expert  # noqa: F401
+from . import composable  # noqa: F401
+from .composable import (  # noqa: F401
+    ComposableBuild,
+    MeshPlan,
+    make_composable_train_step,
+)
 from .ddp import (  # noqa: F401
     sync_gradients,
     bucket_gradients,

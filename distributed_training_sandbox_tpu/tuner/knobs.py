@@ -20,6 +20,28 @@ from dataclasses import asdict, dataclass
 from ..memory_plan.planner import REMAT_POLICIES
 
 
+def mesh_feasible(shape, *, n_devices=None, n_heads=None,
+                  n_kv_heads=None, seq_len=None) -> bool:
+    """Enumeration-time feasibility of one ``mesh_shape`` tuple
+    (dp, fsdp, tp[, sp]): the axis product must equal the device count,
+    tp must divide both head counts, sp must divide the sequence length.
+    Mirrors ``parallel.composable.plan_feasible`` without importing the
+    jax-side machinery (the two are pinned equal by
+    tests/test_composable.py).  Unknown context (None) never prunes."""
+    dp, fsdp, tp, sp = (tuple(shape) + (1, 1, 1, 1))[:4]
+    if min(dp, fsdp, tp, sp) < 1:
+        return False
+    if n_devices is not None and dp * fsdp * tp * sp != n_devices:
+        return False
+    if tp > 1:
+        for heads in (n_heads, n_kv_heads):
+            if heads is not None and heads % tp:
+                return False
+    if sp > 1 and seq_len is not None and seq_len % sp:
+        return False
+    return True
+
+
 @dataclass(frozen=True)
 class TunerCandidate:
     """One point of the tuner's knob space."""
@@ -33,6 +55,7 @@ class TunerCandidate:
     overlap: str = "none"   # "none"|"ring"|"ring_fused"|"ring_fused_pallas"
     sync_every: int = 0            # 0 = pump default (no per-step sync)
     bucket_mb: float | None = None  # DDP-family bucket size
+    mesh_shape: tuple | None = None  # (dp, fsdp, tp[, sp]); None = flat dp
 
     # ------------------------------------------------------------ names
     def bench_name(self) -> str:
@@ -62,6 +85,10 @@ class TunerCandidate:
             parts.append(self.overlap)
         if self.sync_every:
             parts.append(f"sync{self.sync_every}")
+        if self.mesh_shape:
+            # "_mesh2x2x2" — parse_bench_config_name reads this back
+            parts.append("mesh" + "x".join(str(s)
+                                           for s in self.mesh_shape))
         return "_".join(parts)
 
     def label(self) -> str:
@@ -94,8 +121,11 @@ class TunerCandidate:
 
     @classmethod
     def from_dict(cls, d: dict) -> "TunerCandidate":
-        return cls(**{k: d[k] for k in cls.__dataclass_fields__
-                      if k in d})
+        kw = {k: d[k] for k in cls.__dataclass_fields__ if k in d}
+        if kw.get("mesh_shape") is not None:
+            # plan.json round trip: JSON has no tuples
+            kw["mesh_shape"] = tuple(int(s) for s in kw["mesh_shape"])
+        return cls(**kw)
 
 
 # default axes: the envelope of every hand-written KNOB_MATRIX row plus
@@ -112,6 +142,12 @@ _DEFAULT_AXES = dict(
     overlap=("none",),
     sync_every=(0,),
     bucket_mb=(None,),
+    # None = the flat-dp fsdp mesh; tuples are (dp, fsdp, tp) composable
+    # plans — the combinatorial axis the composable driver executes.
+    # Infeasible shapes (axis product != device count, tp not dividing
+    # the head counts) are dropped at enumeration when the context is
+    # known; the analytic waterline prunes the over-budget rest.
+    mesh_shape=(None, (2, 2, 2), (1, 2, 4), (1, 4, 2)),
 )
 
 
@@ -131,6 +167,7 @@ class KnobSpace:
     overlap: tuple = _DEFAULT_AXES["overlap"]
     sync_every: tuple = _DEFAULT_AXES["sync_every"]
     bucket_mb: tuple = _DEFAULT_AXES["bucket_mb"]
+    mesh_shape: tuple = _DEFAULT_AXES["mesh_shape"]
 
     def axes(self) -> dict:
         return {k: list(getattr(self, k))
@@ -142,13 +179,23 @@ class KnobSpace:
         blob = json.dumps(self.axes(), sort_keys=True, default=str)
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
-    def enumerate(self, per_device_batch: int) -> list[TunerCandidate]:
+    def enumerate(self, per_device_batch: int, *,
+                  n_devices: int | None = None,
+                  n_heads: int | None = None,
+                  n_kv_heads: int | None = None,
+                  seq_len: int | None = None) -> list[TunerCandidate]:
         """Every feasible candidate, in a deterministic (sorted-axes
         cross-product) order.  Feasibility = the step factories' own
         rules: accumulation must divide the per-device batch at that
         candidate's scale; activation offload needs a named-save remat
-        policy (same rule as ``memory_plan.enumerate_candidates``)."""
+        policy (same rule as ``memory_plan.enumerate_candidates``); a
+        mesh shape must pass :func:`mesh_feasible` under whatever device
+        /head/sequence context the caller knows (None never prunes)."""
         out = []
+        mesh_shapes = [ms for ms in self.mesh_shape
+                       if ms is None or mesh_feasible(
+                           ms, n_devices=n_devices, n_heads=n_heads,
+                           n_kv_heads=n_kv_heads, seq_len=seq_len)]
         for bs in self.batch_scale:
             pdb = max(per_device_batch, 1) * bs
             for strat in self.strategy:
@@ -165,9 +212,25 @@ class KnobSpace:
                                     for ov in self.overlap:
                                         for se in self.sync_every:
                                             for bm in self.bucket_mb:
-                                                out.append(TunerCandidate(
-                                                    strat, bs, a, r, q, s,
-                                                    o, ov, se, bm))
+                                                for ms in mesh_shapes:
+                                                    if ms is not None \
+                                                            and (s != "full"
+                                                                 or o != "none"):
+                                                        # the composable
+                                                        # step composes
+                                                        # accum/overlap
+                                                        # only — int8
+                                                        # state and
+                                                        # offload are
+                                                        # flat-dp fsdp
+                                                        # knobs
+                                                        continue
+                                                    out.append(
+                                                        TunerCandidate(
+                                                            strat, bs, a,
+                                                            r, q, s, o,
+                                                            ov, se, bm,
+                                                            ms))
         return out
 
     def sample(self, n: int, seed: int,
@@ -181,7 +244,13 @@ class KnobSpace:
 
     @classmethod
     def from_axes(cls, axes: dict) -> "KnobSpace":
-        kw = {k: tuple(v) for k, v in axes.items()
+        def _axis(k, v):
+            if k == "mesh_shape":
+                # JSON round trip: inner lists -> tuples so candidates
+                # and hashes compare equal regardless of provenance
+                return tuple(None if s is None else tuple(s) for s in v)
+            return tuple(v)
+        kw = {k: _axis(k, v) for k, v in axes.items()
               if k in _DEFAULT_AXES}
         return cls(**kw)
 

@@ -35,8 +35,21 @@ def _default_measure(model_name: str, seq: int, base_batch: int,
         return bench.measure(
             model_name, seq, base_batch * c.batch_scale * ws,
             num_steps=num_steps, cfg_overrides=c.cfg_overrides(),
-            step_kwargs=c.step_kwargs())
+            step_kwargs=c.step_kwargs(),
+            mesh_shape=getattr(c, "mesh_shape", None))
     return fn
+
+
+def _candidate_mesh_plan(c):
+    """The MeshPlan a candidate's ``mesh_shape`` names, or None for the
+    flat-dp fsdp family (lazy import: the composable module pulls the
+    jax-side step machinery)."""
+    shape = getattr(c, "mesh_shape", None)
+    if not shape:
+        return None
+    from ..parallel.composable import MeshPlan
+    dp, fsdp, tp, sp = (tuple(shape) + (1, 1, 1, 1))[:4]
+    return MeshPlan(dp=dp, fsdp=fsdp, tp=tp, sp=sp)
 
 
 def prune_candidates(cands, cfg, *, base_batch: int, seq: int, ws: int,
@@ -54,7 +67,8 @@ def prune_candidates(cands, cfg, *, base_batch: int, seq: int, ws: int,
         pred = analytic_waterline(
             pc.apply_to(cfg), batch=batch, seq=seq, ws=ws,
             accum_steps=c.accum_steps, state_precision=c.state_precision,
-            offload=c.offload, capacity_gb=capacity_gb)
+            offload=c.offload, capacity_gb=capacity_gb,
+            mesh_plan=_candidate_mesh_plan(c))
         preds[c] = round(pred.gb, 3)
         if pred.fits is False:
             pruned.append({"config": c.bench_name(),
@@ -93,8 +107,12 @@ def tune(model_name: str, seq: int, base_batch: int, *,
         cost = TunerCostModel.from_artifacts(
             cost_model_path=cost_model_path, prior_paths=prior_paths)
 
-    # 1. enumerate
-    cands = space.enumerate(base_batch)
+    # 1. enumerate — mesh-shape feasibility (axis product == devices,
+    # tp | heads, sp | seq) prunes right here, before any pricing
+    cands = space.enumerate(
+        base_batch, n_devices=ws, n_heads=cfg.num_attention_heads,
+        n_kv_heads=getattr(cfg, "num_key_value_heads", None),
+        seq_len=seq)
     log(f"[tune] stage 1: {len(cands)} candidates from the knob space")
 
     # 2. prune

@@ -1,0 +1,434 @@
+"""Composable N-D mesh driver: one script for every `MeshPlan`.
+
+``--mesh`` names the run — ``"dp8"`` (ddp), ``"dp8xw1"`` (ZeRO-1),
+``"dp8xw3"`` (ZeRO-3), ``"dp8xw3named"``/``"fsdp8"`` (FSDP),
+``"dp4xtp2"`` (Megatron TP), ``"dp4xsp2"`` (ring-attention SP),
+``"dp2xfsdp2xtp2"`` (the 3-axis combo) — and
+``parallel.composable.make_composable_train_step`` resolves it to an
+executable build: shardings from the strategy's partition RuleSet,
+contract from ``analysis.contract_gen``, legacy shapes dispatching to
+the hand step factories so a replayed strategy is BITWISE loss-for-loss
+identical to its bespoke script (pinned by tests/test_composable.py).
+
+Two model families, matching the scripts this driver subsumes:
+
+  * data-parallel W plans (ddp / zero1 / zero2 / zero3) run the toy MLP
+    exactly as ``scripts/_zero_driver.py``'s sharded leg does — same
+    seed chain, same replicated batch, same ``_time_steps`` loop;
+  * transformer plans (fsdp / tp / sp / dp×fsdp×tp) run the packed-LM
+    loop of ``scripts/_2d_driver.py`` with ``train_fsdp.py``'s planner
+    pre-flight: the mesh-aware analytic waterline prices the plan
+    before any compile and rejects predicted-OOM configs.
+
+Runs under the resilience supervisor; the fingerprint deliberately
+excludes the mesh shape so a checkpoint taken under one plan resumes —
+resharded — under another (``--mesh dp8xw3named`` -> ``dp2xfsdp2xtp2``).
+
+Usage:
+  python scripts/train_composable.py --mesh dp2xfsdp2xtp2 \
+      [--model tiny] [--cpu-devices 8] [--num-steps N] [--batch-size N]
+  python scripts/train_composable.py --mesh dp8xw1 --scale 20  # MLP
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+# Prepend the checkout root so the source tree always wins over any
+# installed copy of the package (`pip install -e .` makes this a no-op).
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+# _zero_driver lives beside this file; its _time_steps IS the MLP loop
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from distributed_training_sandbox_tpu.models import MODEL_REGISTRY as MODELS  # noqa: E402
+
+# MeshPlan strategies whose model is the toy MLP (the _zero_driver
+# family); everything else is the packed-LM transformer loop.
+MLP_STRATEGIES = ("ddp", "composable_zero1", "zero2", "zero3")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--cpu-devices", type=int, default=0)
+    p.add_argument("--mesh", default=None, metavar="PLAN",
+                   help="MeshPlan grammar: x-separated <axis><size> / "
+                        "w<0-3>[flat|named] tokens, e.g. dp2xfsdp2xtp2, "
+                        "dp8xw1, dp8xw3named")
+    p.add_argument("--model", choices=sorted(MODELS), default="tiny",
+                   help="transformer plans only")
+    p.add_argument("--scale", type=int, default=20,
+                   help="MLP plans only: divide the 10k toy width by this")
+    p.add_argument("--rebuild", choices=["broadcast", "all_gather"],
+                   default="broadcast",
+                   help="zero1/zero2 plans: param rebuild wire format")
+    p.add_argument("--plan", default=None, metavar="PLAN_JSON",
+                   help="replay a tuner plan (scripts/tune.py): its "
+                        "TrainConfig-level knobs override this driver's "
+                        "flags, and its chosen mesh_shape supplies "
+                        "--mesh when that flag is omitted")
+    args, rest = p.parse_known_args(argv)
+    if args.cpu_devices:
+        from distributed_training_sandbox_tpu.utils import use_cpu_devices
+        use_cpu_devices(args.cpu_devices)
+
+    from distributed_training_sandbox_tpu.parallel.composable import MeshPlan
+    from distributed_training_sandbox_tpu.utils import TrainConfig
+    from distributed_training_sandbox_tpu import resilience as RZ
+
+    plan_doc = None
+    if args.plan:
+        from distributed_training_sandbox_tpu.tuner import load_plan
+        plan_doc = load_plan(args.plan)
+    mesh_txt = args.mesh
+    if not mesh_txt and plan_doc:
+        ms = (plan_doc.get("chosen") or {}).get("knobs", {}) \
+            .get("mesh_shape")
+        if ms:
+            mesh_txt = "x".join(f"{a}{s}" for a, s in
+                                zip(("dp", "fsdp", "tp", "sp"), ms) if s > 1)
+    if not mesh_txt:
+        raise SystemExit("--mesh is required (or --plan with a chosen "
+                         "mesh_shape)")
+    plan = MeshPlan.parse(mesh_txt).normalized()
+    strategy = plan.strategy_name()   # raises on unsupported combos
+    mlp = strategy in MLP_STRATEGIES
+
+    cfg = TrainConfig.from_args(
+        rest, **({"batch_size": 16} if mlp else
+                 {"sequence_length": 256 if args.model == "tiny"
+                  else 8192}))
+    tuner_plan = None
+    if plan_doc is not None:
+        from distributed_training_sandbox_tpu.tuner import (
+            apply_plan_to_train_config)
+        cfg = apply_plan_to_train_config(plan_doc, cfg)
+        tuner_plan = (plan_doc, args.plan)
+        print(f"[composable] replaying plan {args.plan}: "
+              f"{plan_doc['chosen']['config']} (batch {cfg.batch_size})")
+
+    # The fingerprint deliberately omits the mesh shape: a checkpoint
+    # taken under one plan restores — resharded — under any other plan
+    # of the same model family.
+    sup = RZ.Supervisor.from_config(
+        cfg, strategy="composable",
+        extra_fingerprint={"scale": args.scale} if mlp
+        else {"model": args.model})
+    if mlp:
+        return sup.run(lambda ctx: _mlp_leg(args, plan, strategy, cfg,
+                                            ctx, tuner_plan))
+    return sup.run(lambda ctx: _lm_leg(args, rest, plan, strategy, cfg,
+                                       ctx, tuner_plan))
+
+
+def _tuner_stamp(tuner_plan):
+    if tuner_plan is None:
+        return {}
+    from distributed_training_sandbox_tpu.tuner import plan_manifest_stamp
+    return {"tuner": plan_manifest_stamp(tuner_plan[0], tuner_plan[1])}
+
+
+def _mesh_for(plan, strategy, devices=None):
+    from distributed_training_sandbox_tpu.utils import make_mesh
+    if strategy == "composable_dp_fsdp_tp":
+        # the 3-axis step needs all three axes present even at size 1
+        axes = {a: getattr(plan, a) for a in ("dp", "fsdp", "tp")}
+    else:
+        axes = plan.mesh_axes()
+    return make_mesh(axes, devices=devices)
+
+
+def _mlp_leg(args, plan, strategy, cfg, ctx, tuner_plan=None):
+    """The toy-MLP loop, mirroring ``_zero_driver._zero_ab_leg``'s
+    sharded leg step-for-step (seed chain, replicated batch, donate=False,
+    ``_time_steps``) so a replayed W plan is bitwise its zero/ddp twin."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from _zero_driver import _time_steps
+    from distributed_training_sandbox_tpu.analysis import (
+        evaluate_contract, rules_manifest_verdict)
+    from distributed_training_sandbox_tpu.models import zero_toy_mlp
+    from distributed_training_sandbox_tpu.models.mlp import mse_loss
+    from distributed_training_sandbox_tpu.ops import count_collectives
+    from distributed_training_sandbox_tpu.parallel.composable import (
+        make_composable_train_step)
+    from distributed_training_sandbox_tpu.resilience import RunState
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
+    from distributed_training_sandbox_tpu.utils import (
+        ProfileSchedule, Profiler, get, host_to_global,
+        print_memory_stats, set_seed, tree_local_size_mb)
+
+    mesh = _mesh_for(plan, strategy, devices=ctx.mesh_devices())
+    plan.validate(n_devices=mesh.size)
+    ws = get("ws")
+    name = "composable"
+    print(f"[{name}] plan={plan.describe()} -> strategy={strategy} "
+          f"mesh={dict(mesh.shape)} ws={ws} "
+          f"platform={jax.devices()[0].platform} scale={args.scale}")
+
+    key = set_seed(cfg.seed)
+    params = zero_toy_mlp(key, scale=args.scale)
+    kx, ky = jax.random.split(key)
+    width = 10_000 // args.scale
+    batch = tuple(
+        host_to_global(a, mesh, P())
+        for a in (jax.random.normal(kx, (cfg.batch_size, width)),
+                  jax.random.normal(ky, (cfg.batch_size, width))))
+    params = jax.tree.map(lambda a: host_to_global(a, mesh, P()), params)
+
+    # zero3 consumes a CHUNKED loss; leaving loss_fn unset lets the
+    # build derive it from the toy-MLP tree (zero3_mlp_loss), exactly
+    # as _zero_driver does
+    build = make_composable_train_step(
+        params, plan, mesh,
+        loss_fn=None if strategy == "zero3" else mse_loss,
+        rebuild=args.rebuild, donate=False)
+    state0 = (build.params, build.opt_state)
+    rs = ctx.restore(like=RunState(params=state0[0], opt_state=state0[1]))
+    if rs is not None:
+        state0 = (rs.params, rs.opt_state)
+
+    counts = count_collectives(build.step, *state0, batch)
+    # contract context over the FULL tree (the generated/hand formulas
+    # price leaves of the unchunked model), rules over the leg's actual
+    # placed tree (flat chunks at W3)
+    verdict = evaluate_contract(strategy, counts, params=params,
+                                mesh=mesh, **build.contract_kwargs)
+    print(f"[{name}] contract[{strategy}]: {verdict.summary()}")
+    ctx.verify_contract(verdict)
+    rules_verdict = rules_manifest_verdict(strategy, params=state0[0])
+    print(f"[{name}] rules[{strategy}]: "
+          f"{'ok' if rules_verdict['ok'] else 'MISMATCH'}")
+
+    prof = Profiler(trace_dir=f"{cfg.trace_dir}/{name}/{strategy}",
+                    schedule=ProfileSchedule()) if cfg.profile else None
+    with TelemetryRun(name, config=cfg, mesh=mesh, model="toy-mlp",
+                      collective_counts=counts,
+                      contract=verdict.to_dict(),
+                      rules=rules_verdict,
+                      lineage=ctx.manifest_lineage(),
+                      profiler=prof,
+                      extra={"mesh_plan": plan.describe(),
+                             "strategy": strategy, "scale": args.scale,
+                             "rebuild": args.rebuild,
+                             **_tuner_stamp(tuner_plan)}) as telem:
+        (params_f, opt_f), losses, dt = _time_steps(
+            build.step, state0, batch, cfg.num_steps, telem, name,
+            tokens_per_step=cfg.batch_size, cfg=cfg, ctx=ctx)
+    opt_mb = tree_local_size_mb(opt_f.mu) + tree_local_size_mb(opt_f.nu)
+    print(f"[{name}] per-device optimizer state: {opt_mb:.2f} MB (ws={ws})")
+    print_memory_stats(f"{name}-final")
+    if telem.run_dir:
+        print(f"[{name}] telemetry in {telem.run_dir}")
+    return {"telemetry_dirs": [telem.run_dir] if telem.run_dir else [],
+            "plan": plan.describe(), "strategy": strategy, "ws": ws,
+            "opt_mb": opt_mb, "step_ms": dt * 1e3, "counts": counts,
+            "losses": losses}
+
+
+def _lm_leg(args, rest, plan, strategy, cfg, ctx, tuner_plan=None):
+    """The packed-LM loop of ``_2d_driver._leg`` with ``train_fsdp``'s
+    planner pre-flight, generalized over the plan's mesh."""
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+    from distributed_training_sandbox_tpu.analysis import (
+        evaluate_contract, rules_manifest_verdict)
+    from distributed_training_sandbox_tpu.data import (
+        make_packed_dataset, packed_batches)
+    from distributed_training_sandbox_tpu.models import transformer as T
+    from distributed_training_sandbox_tpu.ops import count_collectives
+    from distributed_training_sandbox_tpu.parallel.composable import (
+        make_composable_train_step)
+    from distributed_training_sandbox_tpu.runtime import (
+        DevicePrefetcher, StepPump)
+    from distributed_training_sandbox_tpu import memory_plan as MP
+    from distributed_training_sandbox_tpu import resilience as RZ
+    from distributed_training_sandbox_tpu.telemetry import TelemetryRun
+    from distributed_training_sandbox_tpu.utils import (
+        PerformanceTracker, ProfileSchedule, Profiler,
+        print_memory_stats, set_seed)
+    from distributed_training_sandbox_tpu.utils.flops import (
+        get_model_flops_per_token)
+    from distributed_training_sandbox_tpu.utils.memory import (
+        hbm_capacity_gb)
+
+    def flag_given(flag):
+        return any(r == flag or r.startswith(flag + "=")
+                   for r in rest or [])
+
+    mcfg: T.TransformerConfig = getattr(T, MODELS[args.model])
+    mesh = _mesh_for(plan, strategy, devices=ctx.mesh_devices())
+    n_dev = mesh.size
+    plan.validate(n_devices=n_dev, model_cfg=mcfg,
+                  seq_len=cfg.sequence_length)
+    name = "composable"
+
+    # batch defaults follow the script each strategy replays: fsdp's
+    # 1-per-device, the 2-D drivers' round-to-dp-multiple — generalized
+    # to the plan's data ways (the axes the batch dim shards over)
+    data_ways = plan.data_ways
+    if strategy == "fsdp" and not flag_given("--batch-size"):
+        cfg.batch_size = n_dev
+    if cfg.batch_size % data_ways:
+        if flag_given("--batch-size"):
+            raise SystemExit(f"--batch-size {cfg.batch_size} must be "
+                             f"divisible by the plan's data ways "
+                             f"(dp×fsdp={data_ways})")
+        cfg.batch_size = data_ways * max(1, cfg.batch_size // data_ways)
+    print(f"[{name}] plan={plan.describe()} -> strategy={strategy} "
+          f"model={args.model} ({mcfg.param_count()/1e9:.3f}B) "
+          f"mesh={dict(mesh.shape)} batch={cfg.batch_size} "
+          f"seq={cfg.sequence_length} "
+          f"platform={jax.devices()[0].platform}")
+
+    if cfg.auto_fit:
+        raise SystemExit("--auto-fit searches the flat-dp fsdp knobs "
+                         "(scripts/train_fsdp.py); the composable "
+                         "driver's mesh shape is tuned by "
+                         "scripts/tune.py's mesh_shape axis instead")
+    if cfg.offload != "none":
+        raise SystemExit("--offload is wired for the flat-dp fsdp step "
+                         "(scripts/train_fsdp.py); not yet composed "
+                         "with mesh plans")
+    if cfg.overlap != "none" and strategy not in ("fsdp", "tp"):
+        raise SystemExit(f"--overlap {cfg.overlap} composes with the "
+                         f"fsdp and tp plans only (the generated "
+                         f"dp×fsdp×tp contract prices the non-overlapped "
+                         f"choreography)")
+    per_rank = cfg.batch_size // data_ways
+    if cfg.accum_steps > 1 and per_rank % cfg.accum_steps:
+        raise SystemExit(f"--accum-steps {cfg.accum_steps} must divide "
+                         f"the per-data-rank batch "
+                         f"{cfg.batch_size}/{data_ways}={per_rank}")
+
+    # ---- memory planner pre-flight: mesh-aware waterline ---------------
+    budget = cfg.hbm_budget_gb or hbm_capacity_gb()
+    pred = MP.analytic_waterline(
+        mcfg, batch=cfg.batch_size, seq=cfg.sequence_length, ws=n_dev,
+        accum_steps=max(cfg.accum_steps, 1), capacity_gb=budget,
+        mesh_plan=plan)
+    print(f"[{name}] predicted waterline: {pred.gb:.2f} GB/device "
+          + (f"(budget {budget:.2f} GB)" if budget is not None else ""))
+    if pred.fits is False:
+        raise SystemExit(
+            f"predicted waterline {pred.gb:.2f} GB exceeds the "
+            f"{budget:.2f} GB budget — rejected pre-compile; pick a "
+            f"plan that shards more ways or raise --hbm-budget-gb")
+    mem_record = {**pred.to_dict(), "budget_gb": budget,
+                  "mesh_plan": plan.describe()}
+
+    key = set_seed(cfg.seed)
+    params = T.init_params(key, mcfg)
+    build = make_composable_train_step(
+        params, plan, mesh, model_cfg=mcfg, overlap=cfg.overlap,
+        accum_steps=cfg.accum_steps)
+    del params
+    shards, opt_state = build.params, build.opt_state
+    print_memory_stats(f"{name}-at-rest", params=shards,
+                       opt_state=opt_state)
+    # resume BEFORE lowering — and possibly from a checkpoint written
+    # under a DIFFERENT plan: restore reshards into this build's specs
+    rs = ctx.restore(like=RZ.RunState(params=shards, opt_state=opt_state,
+                                      prng_key=key))
+    if rs is not None:
+        shards, opt_state = rs.params, rs.opt_state
+
+    input_ids, labels = make_packed_dataset(
+        cfg.sequence_length, mcfg.vocab_size,
+        num_tokens=max(cfg.batch_size * cfg.num_steps, 8)
+        * (cfg.sequence_length + 1))
+    probe = (jnp.zeros((cfg.batch_size, cfg.sequence_length),
+                       jnp.int32),) * 2
+    counts = count_collectives(build.step, shards, opt_state, probe)
+    print(f"[{name}] per-step collectives (HLO): {counts}")
+    cname = f"{strategy}_ring" if cfg.overlap == "ring" else strategy
+    verdict = evaluate_contract(cname, counts, params=shards, mesh=mesh,
+                                **build.contract_kwargs)
+    print(f"[{name}] contract[{cname}]: {verdict.summary()}")
+    ctx.verify_contract(verdict)
+    rules_verdict = rules_manifest_verdict(cname, params=shards)
+    print(f"[{name}] rules[{cname}]: "
+          f"{'ok' if rules_verdict['ok'] else 'MISMATCH'} "
+          f"({rules_verdict.get('checked', 0)} leaves checked)")
+
+    flops_tok = get_model_flops_per_token(mcfg, cfg.sequence_length)
+    tracker = PerformanceTracker(
+        warmup_steps=min(3, max(cfg.num_steps - 1, 0)),
+        flops_per_token=flops_tok, num_devices=n_dev)
+    prof = Profiler(trace_dir=cfg.trace_dir,
+                    schedule=ProfileSchedule(skip_first=0, wait=1,
+                                             warmup=2, active=5)) \
+        if cfg.profile else None
+
+    batches = packed_batches(input_ids, labels, cfg.batch_size,
+                             epochs=cfg.num_epochs * cfg.num_steps)
+    if ctx.data_cursor:
+        batches = itertools.islice(batches, ctx.data_cursor, None)
+    pref = DevicePrefetcher(batches, mesh=mesh, spec=build.batch_spec,
+                            depth=cfg.prefetch_depth)
+    with pref, TelemetryRun(
+            name, config=cfg, mesh=mesh, model=args.model,
+            collective_counts=counts, profiler=prof,
+            contract=verdict.to_dict(),
+            rules=rules_verdict,
+            lineage=ctx.manifest_lineage(),
+            extra={"mesh_plan": plan.describe(), "strategy": strategy,
+                   "memory_plan": mem_record,
+                   **_tuner_stamp(tuner_plan)}) as telem:
+        pref.spans = telem.spans   # prefetch waits onto the timeline
+        pref.metrics = telem.metrics
+        with StepPump(telem=telem, tracker=tracker, mode=cfg.dispatch,
+                      sync_every=cfg.sync_every,
+                      max_in_flight=cfg.max_in_flight) as pump:
+            for i, batch in zip(range(ctx.start_step, cfg.num_steps),
+                                pref):
+                if ctx.should_stop(i):
+                    break
+                if i == ctx.start_step:
+                    # ledger join: compiled text at the loop's exact
+                    # shardings; the planner record rides along so the
+                    # memory ledger can verdict measured-vs-predicted
+                    telem.attach_step_hlo(build.step, shards, opt_state,
+                                          batch, prediction=mem_record)
+                shards, opt_state, loss = build.step(shards, opt_state,
+                                                     batch)
+                log = (lambda lf, i=i:
+                       print(f"[{name}] step {i:3d} loss {lf:.4f}")) \
+                    if i % 5 == 0 or i == cfg.num_steps - 1 else None
+                synced = pump.emit(
+                    loss, tokens=cfg.batch_size * cfg.sequence_length,
+                    log=log)
+                ctx.after_step(i, synced, lambda i=i: RZ.RunState(
+                    params=shards, opt_state=opt_state, step=i,
+                    data_cursor=i + 1, prng_key=key,
+                    loss_log=ctx.full_losses(pump.losses)))
+        ctx.finalize(telem)
+    metrics = pump.metrics or {}
+    print(f"[{name}] host syncs: {pump.host_sync_count} "
+          f"({pump.sync_breakdown})")
+    if prof:
+        from distributed_training_sandbox_tpu.utils.trace_analysis import (
+            split_from_trace)
+        sp_ = split_from_trace(cfg.trace_dir)
+        if sp_:
+            print(sp_.report(name))
+    print_memory_stats(f"{name}-final", params=shards,
+                       opt_state=opt_state)
+    if metrics:
+        print(f"[{name}] tokens/s {metrics['tokens_per_second']:.1f} "
+              f"TFLOPS/dev {metrics.get('tflops_per_device', 0):.2f} "
+              f"avg_loss {metrics.get('avg_loss', float('nan')):.4f}")
+    if telem.run_dir:
+        print(f"[{name}] telemetry in {telem.run_dir}")
+    metrics["losses"] = ctx.full_losses(pump.losses)
+    metrics["plan"] = plan.describe()
+    metrics["strategy"] = strategy
+    metrics["telemetry_dirs"] = [telem.run_dir] if telem.run_dir else []
+    return metrics
+
+
+if __name__ == "__main__":
+    main()
